@@ -1,0 +1,61 @@
+"""On-demand native build for the ddstore_tpu C++ core.
+
+Compiles ddstore_tpu/native/*.cc into a shared library with g++ the first
+time the binding is imported (or whenever a source file is newer than the
+cached .so). This replaces the reference's `CC=mpicc CXX=mpicxx pip install .`
+requirement (/root/reference/README.md:20-32) — no MPI toolchain exists on
+TPU-VM hosts, and the library must be usable from a plain checkout.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+_LIB_PATH = os.path.join(_LIB_DIR, "libddstore_tpu.so")
+_SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc", "capi.cc"]
+_HEADERS = ["store.h", "local_transport.h", "tcp_transport.h"]
+_lock = threading.Lock()
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for f in _SOURCES + _HEADERS:
+        if os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime:
+            return True
+    return False
+
+
+def build(force: bool = False) -> str:
+    """Returns the path to the built shared library, compiling if needed."""
+    with _lock:
+        if not force and not _stale():
+            return _LIB_PATH
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        cxx = os.environ.get("DDSTORE_CXX", "g++")
+        cmd = [
+            cxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-Wall",
+        ]
+        cmd += [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+        # Build to a temp path then rename: concurrent test processes may
+        # race on the build, and dlopen of a half-written .so is fatal.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(cmd + ["-o", tmp], check=True, capture_output=True,
+                           text=True)
+            os.replace(tmp, _LIB_PATH)
+        except subprocess.CalledProcessError as e:  # pragma: no cover
+            raise RuntimeError(
+                f"native build failed:\n{e.stderr}") from e
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return _LIB_PATH

@@ -1,0 +1,243 @@
+"""ctypes binding over the native store core.
+
+The native↔Python boundary (role of the reference's Cython binding,
+/root/reference/src/pyddstore.pyx:33-131): numpy buffers cross as raw
+pointers with zero copies on the Python side. Unlike the reference, the
+native core is dtype-agnostic (rows are byte spans), so there is no
+template dispatch — dtype bookkeeping lives in the high-level
+:mod:`ddstore_tpu.store` layer.
+
+ctypes releases the GIL for the duration of every foreign call, so remote
+reads, batched fetches, and barriers never block Python threads (the
+serving thread is pure C++ and never touches the GIL at all — one of the
+design requirements the reference sidesteps by using MPI progress).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ._build import build
+
+_lib: Optional[ctypes.CDLL] = None
+
+_i64 = ctypes.c_int64
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build())
+    lib.dds_create_local.restype = ctypes.c_void_p
+    lib.dds_create_local.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.dds_create_tcp.restype = ctypes.c_void_p
+    lib.dds_create_tcp.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.dds_server_port.restype = ctypes.c_int
+    lib.dds_server_port.argtypes = [ctypes.c_void_p]
+    lib.dds_set_peers.restype = ctypes.c_int
+    lib.dds_set_peers.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_char_p),
+                                  ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.dds_add.restype = ctypes.c_int
+    lib.dds_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                            _i64, _i64, _i64, _i64p, ctypes.c_int]
+    lib.dds_init.restype = ctypes.c_int
+    lib.dds_init.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _i64, _i64,
+                             _i64, _i64p]
+    lib.dds_update.restype = ctypes.c_int
+    lib.dds_update.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_void_p, _i64, _i64]
+    lib.dds_get.restype = ctypes.c_int
+    lib.dds_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                            _i64, _i64]
+    lib.dds_get_batch.restype = ctypes.c_int
+    lib.dds_get_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_void_p, _i64p, _i64]
+    lib.dds_query.restype = ctypes.c_int
+    lib.dds_query.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _i64p, _i64p,
+                              _i64p, _i64p]
+    for fn in ("dds_epoch_begin", "dds_epoch_end"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.dds_set_epoch_collective.restype = ctypes.c_int
+    lib.dds_set_epoch_collective.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dds_free_var.restype = ctypes.c_int
+    lib.dds_free_var.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dds_barrier.restype = ctypes.c_int
+    lib.dds_barrier.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_rank.restype = ctypes.c_int
+    lib.dds_rank.argtypes = [ctypes.c_void_p]
+    lib.dds_world.restype = ctypes.c_int
+    lib.dds_world.argtypes = [ctypes.c_void_p]
+    lib.dds_destroy.restype = None
+    lib.dds_destroy.argtypes = [ctypes.c_void_p]
+    lib.dds_release_local_group.restype = None
+    lib.dds_release_local_group.argtypes = [ctypes.c_char_p]
+    lib.dds_error_string.restype = ctypes.c_char_p
+    lib.dds_error_string.argtypes = [ctypes.c_int]
+    lib.dds_owner_of.restype = ctypes.c_int
+    lib.dds_owner_of.argtypes = [_i64p, ctypes.c_int, _i64]
+    _lib = lib
+    return lib
+
+
+class DDStoreError(RuntimeError):
+    """Raised when the native core reports an error (maps the C error codes
+    the way the reference surfaces C++ throws through Cython ``except +``,
+    pyddstore.pyx:44-50)."""
+
+    def __init__(self, code: int, context: str = ""):
+        self.code = code
+        msg = _load().dds_error_string(code).decode()
+        super().__init__(f"{context}: {msg}" if context else msg)
+
+
+def _check(code: int, context: str = "") -> None:
+    if code != 0:
+        raise DDStoreError(code, context)
+
+
+def owner_of(cum: Sequence[int], row: int) -> int:
+    """Owner rank of global row `row` given cumulative row counts."""
+    arr = np.ascontiguousarray(cum, dtype=np.int64)
+    return _load().dds_owner_of(arr.ctypes.data_as(_i64p), len(arr), row)
+
+
+def _as_i64p(arr: np.ndarray):
+    return arr.ctypes.data_as(_i64p)
+
+
+class NativeStore:
+    """Thin, byte-oriented wrapper over one native store instance."""
+
+    def __init__(self, handle: int, local_gid: Optional[str] = None):
+        if not handle:
+            raise RuntimeError("native store creation failed")
+        self._h = handle
+        self._local_gid = local_gid
+        self._lib = _load()
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def create_local(cls, group_id: str, rank: int, world: int) -> "NativeStore":
+        lib = _load()
+        h = lib.dds_create_local(group_id.encode(), rank, world)
+        return cls(h, local_gid=group_id)
+
+    @classmethod
+    def create_tcp(cls, rank: int, world: int, port: int = 0) -> "NativeStore":
+        lib = _load()
+        h = lib.dds_create_tcp(rank, world, port)
+        return cls(h)
+
+    # -- transport wiring --------------------------------------------------
+
+    @property
+    def server_port(self) -> int:
+        return self._lib.dds_server_port(self._h)
+
+    def set_peers(self, hosts: Sequence[str], ports: Sequence[int]) -> None:
+        n = len(hosts)
+        harr = (ctypes.c_char_p * n)(*[h.encode() for h in hosts])
+        parr = (ctypes.c_int * n)(*ports)
+        _check(self._lib.dds_set_peers(self._h, harr, parr, n), "set_peers")
+
+    # -- data plane --------------------------------------------------------
+
+    def add(self, name: str, arr: np.ndarray, all_nrows: Sequence[int],
+            copy: bool = True) -> None:
+        assert arr.flags["C_CONTIGUOUS"], "shard must be C-contiguous"
+        nrows = arr.shape[0] if arr.ndim else 0
+        # disp comes from the trailing dims, NOT size//nrows: an empty shard
+        # (nrows=0) must still agree with its peers on the row width.
+        disp = int(np.prod(arr.shape[1:], dtype=np.int64)) if arr.ndim > 1 else 1
+        table = np.ascontiguousarray(all_nrows, dtype=np.int64)
+        _check(self._lib.dds_add(
+            self._h, name.encode(), arr.ctypes.data, nrows, disp,
+            arr.itemsize, _as_i64p(table), int(copy)), f"add({name})")
+
+    def init(self, name: str, nrows: int, disp: int, itemsize: int,
+             all_nrows: Sequence[int]) -> None:
+        table = np.ascontiguousarray(all_nrows, dtype=np.int64)
+        _check(self._lib.dds_init(self._h, name.encode(), nrows, disp,
+                                  itemsize, _as_i64p(table)), f"init({name})")
+
+    def update(self, name: str, arr: np.ndarray, row_offset: int) -> None:
+        assert arr.flags["C_CONTIGUOUS"]
+        nrows = arr.shape[0] if arr.ndim else 0
+        _check(self._lib.dds_update(self._h, name.encode(), arr.ctypes.data,
+                                    nrows, row_offset), f"update({name})")
+
+    def get(self, name: str, out: np.ndarray, start: int,
+            count: int) -> None:
+        assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
+        _check(self._lib.dds_get(self._h, name.encode(), out.ctypes.data,
+                                 start, count), f"get({name}, {start})")
+
+    def get_batch(self, name: str, out: np.ndarray,
+                  starts: np.ndarray) -> None:
+        assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
+        starts = np.ascontiguousarray(starts, dtype=np.int64)
+        _check(self._lib.dds_get_batch(self._h, name.encode(),
+                                       out.ctypes.data, _as_i64p(starts),
+                                       len(starts)), f"get_batch({name})")
+
+    def query(self, name: str):
+        total = _i64(0)
+        disp = _i64(0)
+        itemsize = _i64(0)
+        local = _i64(0)
+        _check(self._lib.dds_query(self._h, name.encode(),
+                                   ctypes.byref(total), ctypes.byref(disp),
+                                   ctypes.byref(itemsize), ctypes.byref(local)),
+               f"query({name})")
+        return {"total_rows": total.value, "disp": disp.value,
+                "itemsize": itemsize.value, "local_rows": local.value}
+
+    # -- control plane -----------------------------------------------------
+
+    def epoch_begin(self) -> None:
+        _check(self._lib.dds_epoch_begin(self._h), "epoch_begin")
+
+    def epoch_end(self) -> None:
+        _check(self._lib.dds_epoch_end(self._h), "epoch_end")
+
+    def set_epoch_collective(self, collective: bool) -> None:
+        _check(self._lib.dds_set_epoch_collective(self._h, int(collective)))
+
+    def free_var(self, name: str) -> None:
+        _check(self._lib.dds_free_var(self._h, name.encode()),
+               f"free({name})")
+
+    def barrier(self, tag: int) -> None:
+        _check(self._lib.dds_barrier(self._h, tag), "barrier")
+
+    @property
+    def rank(self) -> int:
+        return self._lib.dds_rank(self._h)
+
+    @property
+    def world(self) -> int:
+        return self._lib.dds_world(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dds_destroy(self._h)
+            self._h = 0
+            if self._local_gid is not None:
+                # Drop the process-global LocalGroup registry entry (peers
+                # that still exist keep the group alive via shared_ptr).
+                self._lib.dds_release_local_group(self._local_gid.encode())
+                self._local_gid = None
+
+    def __del__(self):  # best-effort teardown
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,141 @@
+// C ABI over the store core, consumed by the Python ctypes binding
+// (ddstore_tpu/binding.py). Fills the role of the reference's Cython layer
+// (/root/reference/src/pyddstore.pyx:33-131) but is dtype-agnostic: rows are
+// byte spans here; dtype dispatch lives in Python where numpy already knows
+// it (the reference instantiates six C++ templates instead,
+// pyddstore.pyx:69-82).
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "local_transport.h"
+#include "store.h"
+#include "tcp_transport.h"
+
+using dds::Store;
+
+extern "C" {
+
+struct dds_handle {
+  std::unique_ptr<Store> store;
+  dds::TcpTransport* tcp = nullptr;      // borrowed, owned by store
+  dds::LocalTransport* local = nullptr;  // borrowed, owned by store
+  std::string local_gid;
+};
+
+dds_handle* dds_create_local(const char* group_id, int rank, int world) {
+  auto group = dds::LocalGroup::GetOrCreate(group_id, world);
+  if (!group) return nullptr;
+  auto transport = std::make_unique<dds::LocalTransport>(std::move(group), rank);
+  dds::LocalTransport* raw = transport.get();
+  auto* h = new dds_handle();
+  h->store = std::make_unique<Store>(std::move(transport));
+  h->local = raw;
+  h->local_gid = group_id;
+  raw->Attach(h->store.get());
+  return h;
+}
+
+dds_handle* dds_create_tcp(int rank, int world, int port) {
+  auto transport = std::make_unique<dds::TcpTransport>(rank, world, port);
+  if (transport->server_port() < 0) return nullptr;
+  dds::TcpTransport* raw = transport.get();
+  auto* h = new dds_handle();
+  h->store = std::make_unique<Store>(std::move(transport));
+  h->tcp = raw;
+  raw->Attach(h->store.get());
+  return h;
+}
+
+int dds_server_port(dds_handle* h) {
+  return h && h->tcp ? h->tcp->server_port() : -1;
+}
+
+int dds_set_peers(dds_handle* h, const char** hosts, const int* ports, int n) {
+  if (!h || !h->tcp) return dds::kErrInvalidArg;
+  std::vector<std::string> hs(hosts, hosts + n);
+  std::vector<int> ps(ports, ports + n);
+  return h->tcp->SetPeers(hs, ps);
+}
+
+int dds_add(dds_handle* h, const char* name, const void* buf, int64_t nrows,
+            int64_t disp, int64_t itemsize, const int64_t* all_nrows,
+            int copy) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->Add(name, buf, nrows, disp, itemsize, all_nrows,
+                       copy != 0);
+}
+
+int dds_init(dds_handle* h, const char* name, int64_t nrows, int64_t disp,
+             int64_t itemsize, const int64_t* all_nrows) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->Init(name, nrows, disp, itemsize, all_nrows);
+}
+
+int dds_update(dds_handle* h, const char* name, const void* buf, int64_t nrows,
+               int64_t row_offset) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->Update(name, buf, nrows, row_offset);
+}
+
+int dds_get(dds_handle* h, const char* name, void* dst, int64_t start,
+            int64_t count) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->Get(name, dst, start, count);
+}
+
+int dds_get_batch(dds_handle* h, const char* name, void* dst,
+                  const int64_t* starts, int64_t n) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GetBatch(name, dst, starts, n);
+}
+
+int dds_query(dds_handle* h, const char* name, int64_t* total_rows,
+              int64_t* disp, int64_t* itemsize, int64_t* local_rows) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->Query(name, total_rows, disp, itemsize, local_rows);
+}
+
+int dds_epoch_begin(dds_handle* h) {
+  return h ? h->store->EpochBegin() : dds::kErrInvalidArg;
+}
+
+int dds_epoch_end(dds_handle* h) {
+  return h ? h->store->EpochEnd() : dds::kErrInvalidArg;
+}
+
+int dds_set_epoch_collective(dds_handle* h, int collective) {
+  if (!h) return dds::kErrInvalidArg;
+  h->store->set_epoch_collective(collective != 0);
+  return dds::kOk;
+}
+
+int dds_free_var(dds_handle* h, const char* name) {
+  return h ? h->store->FreeVar(name) : dds::kErrInvalidArg;
+}
+
+int dds_barrier(dds_handle* h, int64_t tag) {
+  return h ? h->store->Barrier(tag) : dds::kErrInvalidArg;
+}
+
+int dds_rank(dds_handle* h) { return h ? h->store->rank() : -1; }
+int dds_world(dds_handle* h) { return h ? h->store->world() : -1; }
+
+void dds_destroy(dds_handle* h) { delete h; }
+
+void dds_release_local_group(const char* gid) {
+  dds::LocalGroup::Release(gid);
+}
+
+const char* dds_error_string(int code) { return dds::ErrorString(code); }
+
+// Exposed for unit tests of the owner-lookup function.
+int dds_owner_of(const int64_t* cum, int n, int64_t row) {
+  std::vector<int64_t> v(cum, cum + n);
+  return Store::OwnerOf(v, row);
+}
+
+}  // extern "C"

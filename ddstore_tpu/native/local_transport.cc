@@ -1,0 +1,83 @@
+#include "local_transport.h"
+
+#include <chrono>
+#include <cstring>
+
+namespace dds {
+
+namespace {
+std::mutex g_groups_mu;
+std::map<std::string, std::shared_ptr<LocalGroup>>* g_groups = nullptr;
+}  // namespace
+
+std::shared_ptr<LocalGroup> LocalGroup::GetOrCreate(const std::string& gid,
+                                                    int world) {
+  std::lock_guard<std::mutex> lock(g_groups_mu);
+  if (!g_groups) g_groups = new std::map<std::string, std::shared_ptr<LocalGroup>>();
+  auto it = g_groups->find(gid);
+  if (it != g_groups->end()) {
+    if (it->second->world() != world) return nullptr;
+    return it->second;
+  }
+  auto g = std::make_shared<LocalGroup>(world);
+  (*g_groups)[gid] = g;
+  return g;
+}
+
+void LocalGroup::Release(const std::string& gid) {
+  std::lock_guard<std::mutex> lock(g_groups_mu);
+  if (g_groups) g_groups->erase(gid);
+}
+
+void LocalGroup::Register(int rank, Store* store) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (rank >= 0 && rank < world_) members_[rank] = store;
+  cv_.notify_all();
+}
+
+void LocalGroup::Unregister(int rank) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (rank >= 0 && rank < world_) members_[rank] = nullptr;
+}
+
+Store* LocalGroup::member(int rank) {
+  std::unique_lock<std::mutex> lock(mu_);
+  if (rank < 0 || rank >= world_) return nullptr;
+  // A peer may not have constructed its store yet (threads race at startup);
+  // wait briefly for registration.
+  cv_.wait_for(lock, std::chrono::seconds(30),
+               [&] { return members_[rank] != nullptr; });
+  return members_[rank];
+}
+
+int LocalGroup::Barrier(int64_t tag) {
+  std::unique_lock<std::mutex> lock(mu_);
+  BarrierState& b = barriers_[tag];
+  ++b.arrived;
+  cv_.notify_all();
+  bool ok = cv_.wait_for(lock, std::chrono::seconds(120), [&] {
+    auto it = barriers_.find(tag);
+    return it != barriers_.end() && it->second.arrived >= world_;
+  });
+  if (!ok) return kErrTransport;
+  BarrierState& b2 = barriers_[tag];
+  if (++b2.left == world_) barriers_.erase(tag);
+  return kOk;
+}
+
+void LocalTransport::Attach(Store* store) { group_->Register(rank_, store); }
+
+LocalTransport::~LocalTransport() { group_->Unregister(rank_); }
+
+int LocalTransport::Read(int target, const std::string& name, int64_t offset,
+                         int64_t nbytes, void* dst) {
+  Store* peer = group_->member(target);
+  if (!peer) return kErrTransport;
+  VarInfo v;
+  if (!peer->GetVarInfo(name, &v)) return kErrNotFound;
+  if (offset < 0 || offset + nbytes > v.shard_bytes()) return kErrOutOfRange;
+  std::memcpy(dst, v.base + offset, nbytes);
+  return kOk;
+}
+
+}  // namespace dds

@@ -1,0 +1,76 @@
+// In-process transport: multiple Store instances in one process (one per
+// "rank", e.g. one per thread in tests) form a named group and read each
+// other's shards with plain memcpy. This is the deterministic fake backend
+// the reference lacks (its only backends are MPI RMA and libfabric,
+// /root/reference/include/ddstore.hpp:54) — it lets unit tests cover index
+// math, bounds, epochs, and batching without any network or multi-process
+// launch.
+
+#ifndef DDSTORE_TPU_LOCAL_TRANSPORT_H_
+#define DDSTORE_TPU_LOCAL_TRANSPORT_H_
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "store.h"
+
+namespace dds {
+
+// Shared state of one in-process group, keyed by group id.
+class LocalGroup {
+ public:
+  static std::shared_ptr<LocalGroup> GetOrCreate(const std::string& gid,
+                                                 int world);
+  // Drop the group from the global registry (members keep their shared_ptr).
+  static void Release(const std::string& gid);
+
+  explicit LocalGroup(int world) : world_(world), members_(world, nullptr) {}
+
+  int world() const { return world_; }
+  void Register(int rank, Store* store);
+  void Unregister(int rank);
+  Store* member(int rank);
+
+  // Counting barrier, per tag; every member must arrive with the same tag.
+  int Barrier(int64_t tag);
+
+ private:
+  struct BarrierState {
+    int arrived = 0;
+    int left = 0;
+  };
+  const int world_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<Store*> members_;
+  std::map<int64_t, BarrierState> barriers_;
+};
+
+class LocalTransport : public Transport {
+ public:
+  LocalTransport(std::shared_ptr<LocalGroup> group, int rank)
+      : group_(std::move(group)), rank_(rank) {}
+  ~LocalTransport() override;
+
+  // Called once the owning Store exists (Store takes the transport in its
+  // constructor, so registration happens just after).
+  void Attach(Store* store);
+
+  int Read(int target, const std::string& name, int64_t offset,
+           int64_t nbytes, void* dst) override;
+  int Barrier(int64_t tag) override { return group_->Barrier(tag); }
+  int rank() const override { return rank_; }
+  int world() const override { return group_->world(); }
+
+ private:
+  std::shared_ptr<LocalGroup> group_;
+  const int rank_;
+};
+
+}  // namespace dds
+
+#endif  // DDSTORE_TPU_LOCAL_TRANSPORT_H_

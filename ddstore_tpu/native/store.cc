@@ -1,0 +1,274 @@
+#include "store.h"
+
+#include <algorithm>
+#include <cstring>
+#include <thread>
+
+namespace dds {
+
+const char* ErrorString(int code) {
+  switch (code) {
+    case kOk: return "ok";
+    case kErrInvalidArg: return "invalid argument";
+    case kErrNotFound: return "variable not found";
+    case kErrOutOfRange: return "row range out of bounds";
+    case kErrCrossShard: return "row range spans more than one shard";
+    case kErrEpochState: return "mismatched epoch_begin/epoch_end";
+    case kErrTransport: return "transport error";
+    case kErrExists: return "variable already exists";
+    case kErrNoMem: return "out of memory";
+    case kErrShapeMismatch: return "shape mismatch across ranks";
+    default: return "unknown error";
+  }
+}
+
+Store::Store(std::unique_ptr<Transport> transport)
+    : transport_(std::move(transport)) {}
+
+Store::~Store() { FreeAll(); }
+
+int Store::rank() const { return transport_->rank(); }
+int Store::world() const { return transport_->world(); }
+
+int Store::OwnerOf(const std::vector<int64_t>& cum, int64_t row) {
+  // First rank whose cumulative count exceeds `row`. cum is nondecreasing;
+  // empty shards (cum[r] == cum[r-1]) are skipped naturally by upper_bound.
+  auto it = std::upper_bound(cum.begin(), cum.end(), row);
+  if (it == cum.end()) return -1;
+  return static_cast<int>(it - cum.begin());
+}
+
+int Store::AddInternal(const std::string& name, const void* buf, int64_t nrows,
+                       int64_t disp, int64_t itemsize,
+                       const int64_t* all_nrows, bool copy, bool zero_fill) {
+  if (name.empty() || disp <= 0 || itemsize <= 0 || nrows < 0)
+    return kErrInvalidArg;
+  std::lock_guard<std::mutex> lock(mu_);
+  if (vars_.count(name)) return kErrExists;
+
+  VarInfo v;
+  v.name = name;
+  v.disp = disp;
+  v.itemsize = itemsize;
+  v.nrows = nrows;
+  v.cum.resize(world());
+  int64_t acc = 0;
+  for (int r = 0; r < world(); ++r) {
+    if (all_nrows[r] < 0) return kErrInvalidArg;
+    acc += all_nrows[r];
+    v.cum[r] = acc;
+  }
+  // Sanity: our slot in the table must match what we were handed.
+  if (all_nrows[rank()] != nrows) return kErrShapeMismatch;
+
+  int64_t bytes = nrows * disp * itemsize;
+  if (zero_fill || copy) {
+    v.base = static_cast<char*>(bytes ? ::malloc(bytes) : ::malloc(1));
+    if (!v.base) return kErrNoMem;
+    v.owned = true;
+    if (zero_fill) {
+      std::memset(v.base, 0, bytes);
+    } else {
+      std::memcpy(v.base, buf, bytes);
+    }
+  } else {
+    // Borrow the caller's buffer (zero-copy registration).
+    v.base = static_cast<char*>(const_cast<void*>(buf));
+    v.owned = false;
+  }
+  vars_.emplace(name, std::move(v));
+  return kOk;
+}
+
+int Store::Add(const std::string& name, const void* buf, int64_t nrows,
+               int64_t disp, int64_t itemsize, const int64_t* all_nrows,
+               bool copy) {
+  if (!buf && nrows > 0) return kErrInvalidArg;
+  return AddInternal(name, buf, nrows, disp, itemsize, all_nrows, copy,
+                     /*zero_fill=*/false);
+}
+
+int Store::Init(const std::string& name, int64_t nrows, int64_t disp,
+                int64_t itemsize, const int64_t* all_nrows) {
+  return AddInternal(name, nullptr, nrows, disp, itemsize, all_nrows,
+                     /*copy=*/false, /*zero_fill=*/true);
+}
+
+int Store::Update(const std::string& name, const void* buf, int64_t nrows,
+                  int64_t row_offset) {
+  if (!buf || nrows < 0 || row_offset < 0) return kErrInvalidArg;
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  VarInfo& v = it->second;
+  if (row_offset + nrows > v.nrows) return kErrOutOfRange;
+  std::memcpy(v.base + row_offset * v.row_bytes(), buf,
+              nrows * v.row_bytes());
+  return kOk;
+}
+
+int Store::Get(const std::string& name, void* dst, int64_t start,
+               int64_t count) {
+  if (!dst || start < 0 || count <= 0) return kErrInvalidArg;
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  if (start + count > v.total_rows()) return kErrOutOfRange;
+
+  int target = OwnerOf(v.cum, start);
+  if (target < 0) return kErrOutOfRange;
+  int64_t shard_begin = target == 0 ? 0 : v.cum[target - 1];
+  // Whole range must live on one shard (single-peer reads; the reference
+  // enforces the same, ddstore.hpp:210-214).
+  if (start + count > v.cum[target]) return kErrCrossShard;
+
+  int64_t offset = (start - shard_begin) * v.row_bytes();
+  int64_t nbytes = count * v.row_bytes();
+  if (target == rank()) {
+    std::memcpy(dst, v.base + offset, nbytes);
+    return kOk;
+  }
+  return transport_->Read(target, name, offset, nbytes, dst);
+}
+
+namespace {
+struct Run {  // a coalesced contiguous read
+  int target;
+  int64_t offset;   // byte offset in target's shard
+  int64_t nbytes;
+  int64_t dst_off;  // byte offset in dst
+};
+}  // namespace
+
+int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
+                    int64_t n) {
+  if (!dst || !starts || n < 0) return kErrInvalidArg;
+  if (n == 0) return kOk;
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  const int64_t rb = v.row_bytes();
+  const int64_t total = v.total_rows();
+
+  // Build coalesced runs: consecutive requested rows that are globally
+  // adjacent and share an owner merge into one transport read.
+  std::vector<Run> runs;
+  runs.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t row = starts[i];
+    if (row < 0 || row >= total) return kErrOutOfRange;
+    int target = OwnerOf(v.cum, row);
+    int64_t shard_begin = target == 0 ? 0 : v.cum[target - 1];
+    int64_t off = (row - shard_begin) * rb;
+    if (!runs.empty()) {
+      Run& last = runs.back();
+      if (last.target == target && last.offset + last.nbytes == off &&
+          last.dst_off + last.nbytes == i * rb) {
+        last.nbytes += rb;
+        continue;
+      }
+    }
+    runs.push_back(Run{target, off, rb, i * rb});
+  }
+
+  // Partition runs by peer; serve local runs inline, issue one worker thread
+  // per distinct remote peer. Each peer's runs go through one pipelined
+  // ReadV (1 round trip amortized over all runs to that peer).
+  std::map<int, std::vector<ReadOp>> by_peer;
+  char* out = static_cast<char*>(dst);
+  for (const Run& r : runs) {
+    if (r.target == rank()) {
+      std::memcpy(out + r.dst_off, v.base + r.offset, r.nbytes);
+    } else {
+      by_peer[r.target].push_back(ReadOp{r.offset, r.nbytes, out + r.dst_off});
+    }
+  }
+  if (by_peer.empty()) return kOk;
+
+  std::vector<std::thread> workers;
+  std::vector<int> rcs(by_peer.size(), kOk);
+  size_t wi = 0;
+  for (auto& kv : by_peer) {
+    int peer = kv.first;
+    std::vector<ReadOp>* ops = &kv.second;
+    int* rc = &rcs[wi++];
+    workers.emplace_back([this, peer, ops, &name, rc]() {
+      *rc = transport_->ReadV(peer, name, ops->data(),
+                              static_cast<int64_t>(ops->size()));
+    });
+  }
+  for (auto& t : workers) t.join();
+  for (int c : rcs)
+    if (c != kOk) return c;
+  return kOk;
+}
+
+int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,
+                 int64_t* itemsize, int64_t* local_rows) const {
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  if (total_rows) *total_rows = v.total_rows();
+  if (disp) *disp = v.disp;
+  if (itemsize) *itemsize = v.itemsize;
+  if (local_rows) *local_rows = v.nrows;
+  return kOk;
+}
+
+int Store::EpochBegin() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (fence_active_) return kErrEpochState;
+    fence_active_ = true;
+    ++epoch_tag_;
+  }
+  if (epoch_collective_ && world() > 1)
+    return transport_->Barrier((epoch_tag_ << 1) | 0);
+  return kOk;
+}
+
+int Store::EpochEnd() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (!fence_active_) return kErrEpochState;
+    fence_active_ = false;
+  }
+  if (epoch_collective_ && world() > 1)
+    return transport_->Barrier((epoch_tag_ << 1) | 1);
+  return kOk;
+}
+
+int Store::FreeVar(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  if (it->second.owned) ::free(it->second.base);
+  vars_.erase(it);
+  return kOk;
+}
+
+int Store::FreeAll() {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& kv : vars_)
+    if (kv.second.owned) ::free(kv.second.base);
+  vars_.clear();
+  return kOk;
+}
+
+int Store::Barrier(int64_t tag) {
+  if (world() <= 1) return kOk;
+  return transport_->Barrier(tag);
+}
+
+char* Store::LocalBase(const std::string& name) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = vars_.find(name);
+  return it == vars_.end() ? nullptr : it->second.base;
+}
+
+bool Store::GetVarInfo(const std::string& name, VarInfo* out) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return false;
+  *out = it->second;  // copies metadata; base pointer stays valid until free
+  return true;
+}
+
+}  // namespace dds

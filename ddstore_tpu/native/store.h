@@ -1,0 +1,197 @@
+// ddstore_tpu native store core.
+//
+// A distributed, in-memory sample store: each process (TPU-VM host) owns one
+// contiguous shard of every registered variable; the global row-index space is
+// the concatenation of all shards in rank order; any rank can read any row via
+// a one-sided remote read through a pluggable Transport.
+//
+// Capability parity with the reference store core (see
+// /root/reference/include/ddstore.hpp:26-258 — variable registry, global index
+// construction, one-sided get, epoch fences, teardown) but designed for TPU-VM
+// pods: no MPI, byte-oriented rows (dtype lives in the Python binding),
+// binary-search owner lookup (the reference scans O(P),
+// src/ddstore.cxx:5-17), 64-bit sizes throughout (the reference caps a get at
+// <2 GiB via int counts, ddstore.hpp:229-236), and the transport factored out
+// behind an interface instead of an `int method` branched at every call site
+// (ddstore.hpp:54,125,219,239).
+
+#ifndef DDSTORE_TPU_STORE_H_
+#define DDSTORE_TPU_STORE_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace dds {
+
+// Error codes returned by every fallible API. Negative values are errors.
+enum ErrorCode : int {
+  kOk = 0,
+  kErrInvalidArg = -1,   // bad name / shape / range
+  kErrNotFound = -2,     // unknown variable
+  kErrOutOfRange = -3,   // row range outside the global index space
+  kErrCrossShard = -4,   // [start, start+count) spans more than one shard
+  kErrEpochState = -5,   // mismatched epoch_begin/epoch_end
+  kErrTransport = -6,    // remote read / barrier failed
+  kErrExists = -7,       // variable already registered
+  kErrNoMem = -8,        // allocation failure
+  kErrShapeMismatch = -9 // disp/itemsize disagree across ranks
+};
+
+const char* ErrorString(int code);
+
+struct VarInfo {
+  std::string name;
+  int64_t disp = 0;      // elements per row (flattened sample width)
+  int64_t itemsize = 0;  // bytes per element
+  int64_t nrows = 0;     // rows in the LOCAL shard
+  // Cumulative row counts: cum[r] = total rows owned by ranks 0..r.
+  // Global rows [cum[r-1], cum[r]) live on rank r. Size == world.
+  std::vector<int64_t> cum;
+  char* base = nullptr;  // local shard memory
+  bool owned = false;    // true if the store allocated (and must free) base
+
+  int64_t row_bytes() const { return disp * itemsize; }
+  int64_t total_rows() const { return cum.empty() ? 0 : cum.back(); }
+  int64_t shard_bytes() const { return nrows * row_bytes(); }
+};
+
+// One contiguous read: `nbytes` at byte offset `offset` of the target's
+// local shard, into `dst`.
+struct ReadOp {
+  int64_t offset;
+  int64_t nbytes;
+  void* dst;
+};
+
+// One-sided read transport. Implementations must be thread-safe: get_batch
+// issues reads to distinct peers concurrently.
+class Transport {
+ public:
+  virtual ~Transport() = default;
+
+  // Read `nbytes` starting at byte offset `offset` within peer `target`'s
+  // local shard of variable `name`, into `dst`. Must not require any action
+  // from the target's application thread (one-sided semantics; the target's
+  // serving thread, if any, is part of the transport).
+  virtual int Read(int target, const std::string& name, int64_t offset,
+                   int64_t nbytes, void* dst) = 0;
+
+  // Vectored read from one peer. Default loops over Read; transports with a
+  // wire protocol override this to pipeline (send all requests, then drain
+  // responses) so n small reads cost ~1 round trip, not n.
+  virtual int ReadV(int target, const std::string& name, const ReadOp* ops,
+                    int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      int rc = Read(target, name, ops[i].offset, ops[i].nbytes, ops[i].dst);
+      if (rc != 0) return rc;
+    }
+    return 0;
+  }
+
+  // Collective tagged barrier across the group. Every rank must call with the
+  // same sequence of tags.
+  virtual int Barrier(int64_t tag) = 0;
+
+  virtual int rank() const = 0;
+  virtual int world() const = 0;
+};
+
+class Store {
+ public:
+  // The store does not own the transport's group membership; rank/world come
+  // from the transport.
+  explicit Store(std::unique_ptr<Transport> transport);
+  ~Store();
+
+  Store(const Store&) = delete;
+  Store& operator=(const Store&) = delete;
+
+  int rank() const;
+  int world() const;
+
+  // Register a shard. `all_nrows` is the per-rank row-count table (size
+  // world), exchanged by the caller (the Python layer allgathers it; the
+  // reference does this with MPI_Allgather, ddstore.hpp:75-89). If `copy` the
+  // store memcpys the buffer into its own allocation (reference behavior,
+  // ddstore.hpp:43-49); otherwise it borrows the caller's buffer, which must
+  // outlive the variable (fixes the registration-time memory doubling).
+  int Add(const std::string& name, const void* buf, int64_t nrows,
+          int64_t disp, int64_t itemsize, const int64_t* all_nrows, bool copy);
+
+  // Register a zero-filled shard for deferred population (reference `init`,
+  // ddstore.hpp:110-179).
+  int Init(const std::string& name, int64_t nrows, int64_t disp,
+           int64_t itemsize, const int64_t* all_nrows);
+
+  // Overwrite `nrows` local rows starting at local row `row_offset`
+  // (reference `update`, ddstore.hpp:181-195 — but bounds-checked here).
+  int Update(const std::string& name, const void* buf, int64_t nrows,
+             int64_t row_offset);
+
+  // Read `count` global rows [start, start+count) into dst. The range must
+  // lie within a single rank's shard (kept from the reference,
+  // ddstore.hpp:210-214: it keeps every read single-peer; use GetBatch for
+  // scattered indices). Local reads short-circuit to memcpy.
+  int Get(const std::string& name, void* dst, int64_t start, int64_t count);
+
+  // Read n single rows with global indices starts[0..n) into dst (densely
+  // packed, n*row_bytes). Reads are coalesced per owner (adjacent runs merge
+  // into one transport read) and issued to distinct peers concurrently. This
+  // is the hot-path fix for the reference's one-blocking-read-per-sample
+  // pattern (ddstore.hpp:197-248 called per sample per batch).
+  int GetBatch(const std::string& name, void* dst, const int64_t* starts,
+               int64_t n);
+
+  // Metadata query: total rows across all ranks (reference `query`,
+  // src/ddstore.cxx:46-49) plus shape info.
+  int Query(const std::string& name, int64_t* total_rows, int64_t* disp,
+            int64_t* itemsize, int64_t* local_rows) const;
+
+  // Epoch fences: collective tagged barrier + memory-visibility point per
+  // batch (reference semantics: MPI_Win_fence over every variable,
+  // src/ddstore.cxx:51-77, with a fence_active state machine that throws on
+  // double begin/end :57-58,71-72). `collective`=false makes them local
+  // no-op state transitions (the reference's method-1 behavior).
+  int EpochBegin();
+  int EpochEnd();
+  void set_epoch_collective(bool collective) { epoch_collective_ = collective; }
+
+  // Drop one variable (MPI_Win_free analogue, src/ddstore.cxx:79-96).
+  int FreeVar(const std::string& name);
+  // Drop everything.
+  int FreeAll();
+
+  // Direct barrier for the Python layer.
+  int Barrier(int64_t tag);
+
+  // Returns base pointer of the local shard (for zero-copy serving / tests),
+  // nullptr if unknown.
+  char* LocalBase(const std::string& name) const;
+
+  // Owner lookup: index of the rank owning global row `row`, via binary
+  // search over the cumulative table. Exposed for tests.
+  static int OwnerOf(const std::vector<int64_t>& cum, int64_t row);
+
+  // Snapshot of variable metadata (for the serving thread).
+  bool GetVarInfo(const std::string& name, VarInfo* out) const;
+
+ private:
+  int AddInternal(const std::string& name, const void* buf, int64_t nrows,
+                  int64_t disp, int64_t itemsize, const int64_t* all_nrows,
+                  bool copy, bool zero_fill);
+
+  mutable std::mutex mu_;
+  std::map<std::string, VarInfo> vars_;
+  std::unique_ptr<Transport> transport_;
+  bool fence_active_ = false;
+  bool epoch_collective_ = true;
+  int64_t epoch_tag_ = 0;
+};
+
+}  // namespace dds
+
+#endif  // DDSTORE_TPU_STORE_H_

@@ -1,0 +1,313 @@
+#include "tcp_transport.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+namespace dds {
+namespace {
+
+constexpr uint32_t kMagic = 0xDD57EAD0;
+enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2 };
+
+#pragma pack(push, 1)
+struct WireReq {
+  uint32_t magic;
+  uint32_t op;
+  int32_t src;
+  uint32_t name_len;
+  int64_t offset;
+  int64_t nbytes;
+  int64_t tag;
+};
+struct WireResp {
+  int32_t status;
+  int32_t pad;
+  int64_t nbytes;
+};
+#pragma pack(pop)
+
+// Max requests in flight on one connection during a pipelined ReadV. Request
+// frames are ~50 bytes; the window keeps total unread request bytes well
+// under any socket buffer so sender and receiver can't deadlock.
+constexpr int64_t kPipelineWindow = 128;
+
+int FullSend(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+int FullRecv(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return -1;
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return 0;
+}
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+TcpTransport::TcpTransport(int rank, int world, int port)
+    : rank_(rank), world_(world) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return;
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(listen_fd_, 1024) < 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  server_port_ = ntohs(addr.sin_port);
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+
+  peers_.resize(world_);
+  for (int i = 0; i < world_; ++i) peers_[i] = std::make_unique<Peer>();
+}
+
+TcpTransport::~TcpTransport() {
+  stopping_.store(true);
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+  }
+  {
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  {
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    for (int fd : conn_fds_) ::close(fd);
+    conn_threads_.clear();
+    conn_fds_.clear();
+  }
+  for (auto& p : peers_) {
+    if (p && p->fd >= 0) ::close(p->fd);
+  }
+}
+
+int TcpTransport::SetPeers(const std::vector<std::string>& hosts,
+                           const std::vector<int>& ports) {
+  if (static_cast<int>(hosts.size()) != world_ ||
+      static_cast<int>(ports.size()) != world_)
+    return kErrInvalidArg;
+  for (int i = 0; i < world_; ++i) {
+    peers_[i]->host = hosts[i];
+    peers_[i]->port = ports[i];
+  }
+  return kOk;
+}
+
+void TcpTransport::AcceptLoop() {
+  while (!stopping_.load()) {
+    sockaddr_in cli;
+    socklen_t len = sizeof(cli);
+    int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&cli), &len);
+    if (fd < 0) {
+      if (stopping_.load()) return;
+      if (errno == EINTR) continue;
+      return;
+    }
+    SetNoDelay(fd);
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    conn_fds_.push_back(fd);
+    conn_threads_.emplace_back([this, fd] { HandleConnection(fd); });
+  }
+}
+
+void TcpTransport::HandleConnection(int fd) {
+  std::string name;
+  while (!stopping_.load()) {
+    WireReq req;
+    if (FullRecv(fd, &req, sizeof(req)) != 0) return;
+    if (req.magic != kMagic || req.name_len > 4096) return;
+    name.resize(req.name_len);
+    if (req.name_len && FullRecv(fd, &name[0], req.name_len) != 0) return;
+
+    if (req.op == kOpBarrier) {
+      {
+        std::lock_guard<std::mutex> lock(barrier_mu_);
+        ++barrier_arrived_[req.tag];
+      }
+      barrier_cv_.notify_all();
+      WireResp resp{kOk, 0, 0};
+      if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+      continue;
+    }
+    if (req.op != kOpRead) return;
+
+    WireResp resp{kOk, 0, 0};
+    VarInfo v;
+    if (!store_ || !store_->GetVarInfo(name, &v)) {
+      resp.status = kErrNotFound;
+    } else if (req.offset < 0 || req.nbytes < 0 ||
+               req.offset + req.nbytes > v.shard_bytes()) {
+      resp.status = kErrOutOfRange;
+    } else {
+      resp.nbytes = req.nbytes;
+    }
+    if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+    if (resp.status == kOk && resp.nbytes > 0) {
+      // Serve straight from the shard: no copy, no registration churn.
+      if (FullSend(fd, v.base + req.offset,
+                   static_cast<size_t>(resp.nbytes)) != 0)
+        return;
+    }
+  }
+}
+
+int TcpTransport::EnsureConnected(Peer& p) {
+  if (p.fd >= 0) return kOk;
+  if (p.port < 0) return kErrTransport;
+
+  addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[16];
+  std::snprintf(portstr, sizeof(portstr), "%d", p.port);
+  if (::getaddrinfo(p.host.c_str(), portstr, &hints, &res) != 0 || !res)
+    return kErrTransport;
+
+  int fd = -1;
+  for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    // Peers start asynchronously; retry connect briefly.
+    int attempts = 0;
+    while (::connect(fd, ai->ai_addr, ai->ai_addrlen) < 0) {
+      if ((errno == ECONNREFUSED || errno == ETIMEDOUT) && attempts++ < 600 &&
+          !stopping_.load()) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        continue;
+      }
+      ::close(fd);
+      fd = -1;
+      break;
+    }
+    if (fd >= 0) break;
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return kErrTransport;
+  SetNoDelay(fd);
+  p.fd = fd;
+  return kOk;
+}
+
+int TcpTransport::Read(int target, const std::string& name, int64_t offset,
+                       int64_t nbytes, void* dst) {
+  ReadOp op{offset, nbytes, dst};
+  return ReadV(target, name, &op, 1);
+}
+
+int TcpTransport::ReadV(int target, const std::string& name, const ReadOp* ops,
+                        int64_t n) {
+  if (target < 0 || target >= world_ || target == rank_) return kErrInvalidArg;
+  Peer& p = *peers_[target];
+  std::lock_guard<std::mutex> lock(p.mu);
+  int rc = EnsureConnected(p);
+  if (rc != kOk) return rc;
+
+  auto fail = [&]() {
+    ::close(p.fd);
+    p.fd = -1;
+    return kErrTransport;
+  };
+
+  int64_t sent = 0, recvd = 0;
+  while (recvd < n) {
+    // Keep the pipeline full without overrunning socket buffers.
+    while (sent < n && sent - recvd < kPipelineWindow) {
+      WireReq req{kMagic,         kOpRead,
+                  rank_,          static_cast<uint32_t>(name.size()),
+                  ops[sent].offset, ops[sent].nbytes,
+                  0};
+      if (FullSend(p.fd, &req, sizeof(req)) != 0) return fail();
+      if (FullSend(p.fd, name.data(), name.size()) != 0) return fail();
+      ++sent;
+    }
+    WireResp resp;
+    if (FullRecv(p.fd, &resp, sizeof(resp)) != 0) return fail();
+    if (resp.status != kOk) {
+      // Outstanding pipelined responses are still in flight; reset the
+      // connection so the next ReadV can't consume a stale frame as fresh
+      // data. EnsureConnected reconnects lazily.
+      int status = resp.status;
+      fail();
+      return status;
+    }
+    if (resp.nbytes != ops[recvd].nbytes) return fail();
+    if (resp.nbytes > 0 &&
+        FullRecv(p.fd, ops[recvd].dst, static_cast<size_t>(resp.nbytes)) != 0)
+      return fail();
+    ++recvd;
+  }
+  return kOk;
+}
+
+int TcpTransport::Barrier(int64_t tag) {
+  // Notify every peer, then wait until every peer has notified us.
+  for (int r = 0; r < world_; ++r) {
+    if (r == rank_) continue;
+    Peer& p = *peers_[r];
+    std::lock_guard<std::mutex> lock(p.mu);
+    int rc = EnsureConnected(p);
+    if (rc != kOk) return rc;
+    WireReq req{kMagic, kOpBarrier, rank_, 0, 0, 0, tag};
+    if (FullSend(p.fd, &req, sizeof(req)) != 0) return kErrTransport;
+    WireResp resp;
+    if (FullRecv(p.fd, &resp, sizeof(resp)) != 0 || resp.status != kOk)
+      return kErrTransport;
+  }
+  std::unique_lock<std::mutex> lock(barrier_mu_);
+  bool ok = barrier_cv_.wait_for(lock, std::chrono::seconds(300), [&] {
+    auto it = barrier_arrived_.find(tag);
+    return it != barrier_arrived_.end() && it->second >= world_ - 1;
+  });
+  if (!ok) return kErrTransport;
+  barrier_arrived_.erase(tag);
+  return kOk;
+}
+
+}  // namespace dds

@@ -1,0 +1,91 @@
+// TCP one-sided read transport for TPU-VM hosts (DCN path).
+//
+// TPU-VM hosts have no MPI and no RDMA verbs fabric; the equivalent of the
+// reference's one-sided backends (MPI_Get under passive-target lock,
+// /root/reference/include/ddstore.hpp:219-238, and libfabric fi_read,
+// /root/reference/src/common.cxx:311-376) is a per-host serving thread that
+// exposes the shard memory over TCP: readers send (var, offset, nbytes) and
+// the server replies with the bytes, never involving the target's
+// application/training thread. Deliberate non-reproductions of the
+// reference's scars: no per-call memory registration (common.cxx:314-323
+// re-registers an MR on every read and leaks it), no spin-polling
+// (common.cxx:359-373), no fixed 80K-rank static peer tables (common.h:11),
+// and requests to one peer are pipelined instead of one blocking op at a
+// time.
+
+#ifndef DDSTORE_TPU_TCP_TRANSPORT_H_
+#define DDSTORE_TPU_TCP_TRANSPORT_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "store.h"
+
+namespace dds {
+
+class TcpTransport : public Transport {
+ public:
+  // Starts the serving thread immediately; binds to `port` (0 = ephemeral).
+  TcpTransport(int rank, int world, int port);
+  ~TcpTransport() override;
+
+  // The port actually bound (for rendezvous). -1 if the server failed.
+  int server_port() const { return server_port_; }
+
+  // Called once the owning Store exists; the server reads shards through it.
+  void Attach(Store* store) { store_ = store; }
+
+  // Peer endpoint table, from the caller's rendezvous (the reference
+  // exchanges endpoints with MPI_Allgather, common.cxx:285-302; here the
+  // Python layer does it). Must be called before any Read/Barrier.
+  int SetPeers(const std::vector<std::string>& hosts,
+               const std::vector<int>& ports);
+
+  int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
+           void* dst) override;
+  int ReadV(int target, const std::string& name, const ReadOp* ops,
+            int64_t n) override;
+  int Barrier(int64_t tag) override;
+  int rank() const override { return rank_; }
+  int world() const override { return world_; }
+
+ private:
+  struct Peer {
+    std::string host;
+    int port = -1;
+    int fd = -1;
+    std::mutex mu;  // serializes use of this connection
+  };
+
+  int EnsureConnected(Peer& p);
+  void AcceptLoop();
+  void HandleConnection(int fd);
+
+  const int rank_;
+  const int world_;
+  std::atomic<bool> stopping_{false};
+  Store* store_ = nullptr;
+
+  int listen_fd_ = -1;
+  int server_port_ = -1;
+  std::thread accept_thread_;
+  std::mutex conns_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+
+  std::vector<std::unique_ptr<Peer>> peers_;
+
+  // Barrier bookkeeping: arrivals counted by the serving side.
+  std::mutex barrier_mu_;
+  std::condition_variable barrier_cv_;
+  std::map<int64_t, int> barrier_arrived_;
+};
+
+}  // namespace dds
+
+#endif  // DDSTORE_TPU_TCP_TRANSPORT_H_

@@ -1,0 +1,333 @@
+"""Process-group formation and out-of-band metadata exchange.
+
+The reference does all of its control-plane exchange with MPI collectives
+(``MPI_Allgather`` of shard lengths, endpoint names, and rkeys —
+/root/reference/include/ddstore.hpp:75-89, src/common.cxx:285-306). TPU-VM
+hosts have no MPI, so the control plane is its own small abstraction here: a
+:class:`ProcessGroup` provides ``rank``/``size``/``allgather``/``barrier``/
+``split``, with four implementations:
+
+* :class:`SingleGroup` — one process (degenerate but uniform).
+* :class:`ThreadGroup` — N "ranks" as threads of one process; pairs with the
+  in-process transport for unit tests.
+* :class:`FileGroup` — N local processes rendezvous through a shared
+  directory; pairs with the TCP transport — the ``mpirun -n 4`` analogue for
+  multi-process tests on one machine (reference test strategy,
+  README.md:182-198).
+* :class:`JaxGroup` — wraps an initialized ``jax.distributed`` runtime on a
+  real multi-host pod (process_index/process_count + multihost utils).
+
+Only setup-time metadata moves through these groups; the data plane and the
+per-batch epoch barrier run over the native transport.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ProcessGroup:
+    """Abstract control-plane group."""
+
+    rank: int
+    size: int
+
+    def allgather(self, obj: Any) -> List[Any]:
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        self.allgather(None)
+
+    def split(self, color: int) -> "ProcessGroup":
+        """Partition into subgroups of ranks sharing `color` (the
+        ``comm.Split(rank // width, rank)`` replica-group mechanism,
+        reference examples/vae/distdataset.py:25-30). Rank order within a
+        subgroup follows parent rank order."""
+        raise NotImplementedError
+
+    def broadcast(self, obj: Any, root: int = 0) -> Any:
+        return self.allgather(obj)[root]
+
+
+class SingleGroup(ProcessGroup):
+    def __init__(self):
+        self.rank = 0
+        self.size = 1
+
+    def allgather(self, obj: Any) -> List[Any]:
+        return [obj]
+
+    def split(self, color: int) -> "ProcessGroup":
+        return SingleGroup()
+
+
+class _ThreadGroupState:
+    def __init__(self, size: int):
+        self.size = size
+        self.lock = threading.Lock()
+        self.cv = threading.Condition(self.lock)
+        self.seq = 0
+        self.slots: Dict[int, List[Any]] = {}
+        self.arrived: Dict[int, int] = {}
+        self.left: Dict[int, int] = {}
+
+
+_thread_groups: Dict[str, _ThreadGroupState] = {}
+_thread_groups_lock = threading.Lock()
+
+
+class ThreadGroup(ProcessGroup):
+    """All ranks are threads in one process, sharing state by name."""
+
+    def __init__(self, name: str, rank: int, size: int):
+        self.name = name
+        self.rank = rank
+        self.size = size
+        with _thread_groups_lock:
+            st = _thread_groups.get(name)
+            if st is None:
+                st = _ThreadGroupState(size)
+                _thread_groups[name] = st
+        assert st.size == size
+        self._st = st
+        self._seq = 0
+
+    def allgather(self, obj: Any) -> List[Any]:
+        st = self._st
+        seq = self._seq
+        self._seq += 1
+        with st.cv:
+            slot = st.slots.setdefault(seq, [None] * st.size)
+            slot[self.rank] = obj
+            st.arrived[seq] = st.arrived.get(seq, 0) + 1
+            st.cv.notify_all()
+            if not st.cv.wait_for(lambda: st.arrived.get(seq, 0) >= st.size,
+                                  timeout=120):
+                raise TimeoutError("ThreadGroup allgather timed out")
+            result = list(st.slots[seq])
+            st.left[seq] = st.left.get(seq, 0) + 1
+            if st.left[seq] == st.size:
+                del st.slots[seq], st.arrived[seq], st.left[seq]
+        return result
+
+    def split(self, color: int) -> "ProcessGroup":
+        colors = self.allgather(color)
+        members = [r for r, c in enumerate(colors) if c == color]
+        return ThreadGroup(f"{self.name}/s{self._seq}c{color}",
+                           members.index(self.rank), len(members))
+
+
+class FileGroup(ProcessGroup):
+    """Rendezvous through a shared directory (local multi-process tests, or
+    any shared filesystem). Each collective writes ``{run}.{seq}.{rank}.pkl``
+    and polls for the full set.
+
+    Staleness protocol: rank 0 cleans the directory and atomically publishes
+    a MARKER file holding a fresh run nonce; every other rank waits for the
+    marker and namespaces its files by that nonce. A previous (crashed or
+    finished) run's files can therefore never be consumed as live data —
+    the worst case for a botched launch is a timeout, never wrong peers.
+    One directory per concurrent job; files are pickles, so the directory
+    must not be writable by untrusted users (created 0700).
+    """
+
+    def __init__(self, root: str, rank: int, size: int,
+                 timeout: float = 120.0):
+        self.root = root
+        self.rank = rank
+        self.size = size
+        self.timeout = timeout
+        os.makedirs(root, exist_ok=True)
+        try:
+            os.chmod(root, 0o700)
+        except OSError:
+            pass
+        self._seq = 0
+        marker = os.path.join(root, "MARKER")
+        if rank == 0:
+            import uuid as _uuid
+
+            for f in os.listdir(root):
+                if f.endswith(".pkl") or f == "MARKER":
+                    try:
+                        os.unlink(os.path.join(root, f))
+                    except OSError:
+                        pass
+            self._run = _uuid.uuid4().hex[:12]
+            tmp = marker + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(self._run)
+            os.replace(tmp, marker)
+        else:
+            self._run = self._read_marker(marker, time.time() + timeout)
+        # Hello phase: everyone publishes {run}.hello.{rank} and waits for
+        # the full set, re-reading the marker while waiting — a rank that
+        # raced ahead and picked up the PREVIOUS run's marker converges to
+        # rank 0's fresh nonce instead of timing out.
+        deadline = time.time() + timeout
+        written_for = None
+        while True:
+            if written_for != self._run:
+                hello = os.path.join(root, f"{self._run}.hello.{self.rank}.pkl")
+                with open(hello + ".tmp", "w") as fh:
+                    fh.write("x")
+                os.replace(hello + ".tmp", hello)
+                written_for = self._run
+            missing = [r for r in range(size) if not os.path.exists(
+                os.path.join(root, f"{self._run}.hello.{r}.pkl"))]
+            if not missing:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"FileGroup hello: missing ranks {missing} in {root}")
+            time.sleep(0.005)
+            if rank != 0:
+                try:
+                    self._run = self._read_marker(marker, deadline)
+                except TimeoutError:
+                    pass
+
+    @staticmethod
+    def _read_marker(marker: str, deadline: float) -> str:
+        while True:
+            try:
+                with open(marker) as fh:
+                    run = fh.read().strip()
+                if run:
+                    return run
+            except OSError:
+                pass
+            if time.time() > deadline:
+                raise TimeoutError(f"FileGroup: no MARKER at {marker}")
+            time.sleep(0.005)
+
+    def allgather(self, obj: Any) -> List[Any]:
+        seq = self._seq
+        self._seq += 1
+        path = os.path.join(self.root, f"{self._run}.{seq}.{self.rank}.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, path)  # atomic publish
+        deadline = time.time() + self.timeout
+        result: List[Any] = [None] * self.size
+        pending = set(range(self.size))
+        while pending:
+            for r in list(pending):
+                p = os.path.join(self.root, f"{self._run}.{seq}.{r}.pkl")
+                if os.path.exists(p):
+                    try:
+                        with open(p, "rb") as f:
+                            result[r] = pickle.load(f)
+                    except (EOFError, pickle.UnpicklingError):
+                        continue  # writer mid-replace on some filesystems
+                    pending.discard(r)
+            if pending:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"FileGroup allgather {seq}: missing ranks {pending}")
+                time.sleep(0.005)
+        return result
+
+    def split(self, color: int) -> "ProcessGroup":
+        colors = self.allgather(color)
+        members = [r for r, c in enumerate(colors) if c == color]
+        sub = FileGroup(os.path.join(self.root, f"s{self._seq}c{color}"),
+                        members.index(self.rank), len(members),
+                        self.timeout)
+        return sub
+
+
+class JaxGroup(ProcessGroup):
+    """Control plane over an initialized ``jax.distributed`` runtime — the
+    production path on a multi-host TPU pod. Uses the in-process KV store of
+    the distributed runtime via ``multihost_utils`` broadcast."""
+
+    def __init__(self, prefix: str = "ddstore"):
+        import jax
+
+        self.rank = jax.process_index()
+        self.size = jax.process_count()
+        self._prefix = prefix
+        self._seq = 0
+
+    def allgather(self, obj: Any) -> List[Any]:
+        import jax
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        self._seq += 1
+        payload = pickle.dumps(obj)
+        # Fixed-width byte tensor allgather: broadcast lengths first.
+        n = np.int64(len(payload))
+        lens = multihost_utils.process_allgather(n)
+        width = int(max(lens))
+        buf = np.zeros(width, dtype=np.uint8)
+        buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        gathered = multihost_utils.process_allgather(buf)
+        out = []
+        for r in range(self.size):
+            out.append(pickle.loads(gathered[r, : int(lens[r])].tobytes()))
+        return out
+
+    def barrier(self) -> None:
+        from jax.experimental import multihost_utils
+
+        self._seq += 1
+        multihost_utils.sync_global_devices(f"{self._prefix}:{self._seq}")
+
+    def split(self, color: int) -> "ProcessGroup":
+        colors = self.allgather(color)
+        members = [r for r, c in enumerate(colors) if c == color]
+        return _SubGroup(self, members.index(self.rank), members)
+
+
+class _SubGroup(ProcessGroup):
+    """Subgroup view over a parent group: collectives run on the parent and
+    are filtered to members (every parent rank participates, like
+    ``comm.Split`` where all ranks call the collective)."""
+
+    def __init__(self, parent: ProcessGroup, rank: int, members: List[int]):
+        self.parent = parent
+        self.rank = rank
+        self.size = len(members)
+        self.members = members
+
+    def allgather(self, obj: Any) -> List[Any]:
+        everything = self.parent.allgather(obj)
+        return [everything[m] for m in self.members]
+
+    def split(self, color: int) -> "ProcessGroup":
+        colors = self.allgather(color)
+        members = [r for r, c in enumerate(colors) if c == color]
+        return _SubGroup(self, members.index(self.rank),
+                         members)
+
+
+def auto_group(timeout: float = 120.0) -> ProcessGroup:
+    """Pick a group from the environment.
+
+    Priority: explicit ``DDSTORE_RANK``/``DDSTORE_WORLD``/``DDSTORE_RDV_DIR``
+    (file rendezvous, the test harness path) → initialized jax.distributed →
+    single process. The env-var inventory mirrors the reference's
+    (``DDSTORE_METHOD``/SLURM vars, distdataset.py:32-34) but with the
+    TPU-pod deployment model.
+    """
+    if "DDSTORE_RANK" in os.environ:
+        rank = int(os.environ["DDSTORE_RANK"])
+        world = int(os.environ["DDSTORE_WORLD"])
+        root = os.environ.get(
+            "DDSTORE_RDV_DIR", f"/tmp/ddstore_rdv_{os.getuid()}")
+        return FileGroup(root, rank, world, timeout)
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return JaxGroup()
+    except Exception:
+        pass
+    return SingleGroup()

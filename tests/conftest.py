@@ -1,0 +1,26 @@
+"""Test harness setup.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``) — the TPU-pod analogue of the
+reference's "MPI ranks as local processes" strategy
+(/root/reference/README.md:182-198). The env vars must be set before the
+first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep CPU test jobs from oversubscribing the machine.
+os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

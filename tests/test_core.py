@@ -1,0 +1,209 @@
+"""Unit tests for the native core through the binding: owner lookup, bounds,
+epoch state machine, dtype round-trips — the single-process coverage the
+reference has no framework for (SURVEY §4: its tests are three MPI-launched
+scripts with inline asserts)."""
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStore, DDStoreError, SingleGroup, owner_of
+
+
+def make_store(**kw):
+    return DDStore(SingleGroup(), backend="local", **kw)
+
+
+class TestOwnerLookup:
+    def test_basic(self):
+        # Shards of 3, 2, 5 rows → cum [3, 5, 10].
+        cum = [3, 5, 10]
+        assert [owner_of(cum, r) for r in range(10)] == \
+            [0, 0, 0, 1, 1, 2, 2, 2, 2, 2]
+
+    def test_out_of_range(self):
+        assert owner_of([3, 5], 5) == -1
+        assert owner_of([3, 5], 99) == -1
+
+    def test_empty_shards_skipped(self):
+        # Rank 1 owns nothing: cum [2, 2, 4] → rows 2,3 belong to rank 2.
+        cum = [2, 2, 4]
+        assert owner_of(cum, 1) == 0
+        assert owner_of(cum, 2) == 2
+        assert owner_of(cum, 3) == 2
+
+    def test_leading_empty_shard(self):
+        cum = [0, 4]
+        assert owner_of(cum, 0) == 1
+
+    def test_property_matches_numpy(self, rng):
+        # Property test (SURVEY §4 implication): owner_of == searchsorted.
+        for _ in range(50):
+            counts = rng.integers(0, 20, size=rng.integers(1, 16))
+            cum = np.cumsum(counts).astype(np.int64)
+            total = int(cum[-1]) if len(cum) else 0
+            if total == 0:
+                continue
+            rows = rng.integers(0, total, size=32)
+            expect = np.searchsorted(cum, rows, side="right")
+            got = [owner_of(cum, int(r)) for r in rows]
+            assert got == list(expect)
+
+
+class TestSingleProcessStore:
+    def test_add_get_roundtrip(self, rng):
+        with make_store() as s:
+            data = rng.standard_normal((16, 4, 7)).astype(np.float32)
+            s.add("x", data)
+            got = s.get("x", 3, 5)
+            np.testing.assert_array_equal(got, data[3:8])
+            assert got.dtype == np.float32
+            assert got.shape == (5, 4, 7)
+
+    def test_get_batch_scattered(self, rng):
+        with make_store() as s:
+            data = rng.standard_normal((64, 3)).astype(np.float64)
+            s.add("x", data)
+            idx = rng.integers(0, 64, size=37)
+            got = s.get_batch("x", idx)
+            np.testing.assert_array_equal(got, data[idx])
+
+    def test_1d_rows(self):
+        with make_store() as s:
+            data = np.arange(10, dtype=np.int64)
+            s.add("x", data)
+            assert s.get("x", 7)[0] == 7
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                       np.int64, np.uint8, np.int8,
+                                       np.uint16, np.bool_])
+    def test_dtypes(self, dtype, rng):
+        # Reference supports six dtypes via template dispatch
+        # (pyddstore.pyx:69-80); byte-oriented rows support any fixed-width
+        # dtype for free.
+        with make_store() as s:
+            data = (rng.integers(0, 2, size=(8, 5)) * 3).astype(dtype)
+            s.add("x", data)
+            np.testing.assert_array_equal(s.get_batch("x", [1, 4, 2]),
+                                          data[[1, 4, 2]])
+
+    def test_bounds(self):
+        with make_store() as s:
+            s.add("x", np.zeros((10, 2), np.float32))
+            with pytest.raises(DDStoreError):
+                s.get("x", 10)  # out of range
+            with pytest.raises(DDStoreError):
+                s.get("x", -1)
+            with pytest.raises(DDStoreError):
+                s.get("x", 8, 5)  # runs past the end
+            with pytest.raises(DDStoreError):
+                s.get_batch("x", [0, 11])
+
+    def test_unknown_var(self):
+        with make_store() as s:
+            with pytest.raises(KeyError):
+                s.get("nope", 0)
+
+    def test_duplicate_add(self):
+        with make_store() as s:
+            s.add("x", np.zeros((2, 2), np.float32))
+            with pytest.raises(DDStoreError):
+                s.add("x", np.zeros((2, 2), np.float32))
+
+    def test_init_update(self, rng):
+        # Deferred population (reference init/update, ddstore.hpp:110-195).
+        with make_store() as s:
+            s.init("x", 10, (4,), np.float32)
+            np.testing.assert_array_equal(s.get("x", 0, 10),
+                                          np.zeros((10, 4), np.float32))
+            chunk = rng.standard_normal((3, 4)).astype(np.float32)
+            s.update("x", chunk, row_offset=5)
+            np.testing.assert_array_equal(s.get("x", 5, 3), chunk)
+
+    def test_update_bounds(self):
+        with make_store() as s:
+            s.init("x", 4, (2,), np.float32)
+            with pytest.raises(DDStoreError):
+                s.update("x", np.zeros((3, 2), np.float32), row_offset=2)
+
+    def test_free(self):
+        with make_store() as s:
+            s.add("x", np.zeros((2, 2), np.float32))
+            s.free("x")
+            with pytest.raises(KeyError):
+                s.get("x", 0)
+            # re-register after free is allowed
+            s.add("x", np.ones((2, 2), np.float32))
+            assert s.get("x", 1)[0, 0] == 1
+
+    def test_query(self):
+        with make_store() as s:
+            s.add("x", np.zeros((12, 3, 2), np.int16))
+            q = s.query("x")
+            assert q["total_rows"] == 12
+            assert q["local_rows"] == 12
+            assert q["disp"] == 6
+            assert q["itemsize"] == 2
+            assert q["sample_shape"] == (3, 2)
+
+    def test_out_validation(self, rng):
+        # The native core writes count*row_bytes blindly; a wrong out buffer
+        # must be rejected, never coerced (heap-safety regression test).
+        with make_store() as s:
+            s.add("x", rng.standard_normal((8, 16)).astype(np.float64))
+            with pytest.raises(ValueError):
+                s.get("x", 0, 4, out=np.empty((4, 16), np.float32))
+            with pytest.raises(ValueError):
+                s.get("x", 0, 4, out=np.empty((4, 8), np.float64))
+            with pytest.raises(ValueError):
+                s.get_batch("x", [0, 1], out=np.empty((3, 16), np.float64))
+            ok = np.empty((2, 16), np.float64)
+            assert s.get_batch("x", [0, 1], out=ok) is ok
+
+    def test_update_shape_validation(self):
+        with make_store() as s:
+            s.init("x", 8, (16,), np.float32)
+            with pytest.raises(ValueError):
+                s.update("x", np.zeros((4, 8), np.float32))
+
+    def test_zero_copy_borrow_keeps_temp_alive(self):
+        # copy=False with a non-contiguous source: the store must pin the
+        # contiguous materialization it actually registered.
+        import gc
+        with DDStore(SingleGroup(), backend="local", copy=False) as s:
+            base = np.arange(64, dtype=np.float64).reshape(8, 8)
+            view = base[:, ::2]  # non-contiguous
+            expect = np.ascontiguousarray(view).copy()
+            s.add("x", view)
+            del base, view
+            gc.collect()
+            np.testing.assert_array_equal(s.get("x", 0, 8), expect)
+
+    def test_zero_copy_borrow(self):
+        # copy=False borrows the caller's buffer: writes show through.
+        with DDStore(SingleGroup(), backend="local", copy=False) as s:
+            data = np.zeros((4, 2), np.float32)
+            s.add("x", data)
+            data[2, :] = 7
+            assert s.get("x", 2)[0, 0] == 7
+
+
+class TestEpochStateMachine:
+    # Mirrors the reference's fence_active guards
+    # (src/ddstore.cxx:57-58, 71-72): double-begin and double-end throw.
+    def test_double_begin(self):
+        with make_store() as s:
+            s.epoch_begin()
+            with pytest.raises(DDStoreError):
+                s.epoch_begin()
+            s.epoch_end()
+
+    def test_end_without_begin(self):
+        with make_store() as s:
+            with pytest.raises(DDStoreError):
+                s.epoch_end()
+
+    def test_begin_end_cycle(self):
+        with make_store() as s:
+            for _ in range(3):
+                s.epoch_begin()
+                s.epoch_end()

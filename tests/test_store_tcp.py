@@ -1,0 +1,143 @@
+"""Multi-process store tests over the TCP (DCN) transport on localhost —
+the analogue of the reference's ``mpirun -n 4 python test/demo.py`` strategy
+(README.md:182-198): real processes, real sockets, rank-stamp oracle."""
+
+import multiprocessing as mp
+import os
+import sys
+
+import numpy as np
+import pytest
+
+NUM, DIM = 32, 16
+
+
+def _spawn(world, target, tmp, extra=()):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(r, world, tmp, q, *extra))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(world):
+            r, err = q.get(timeout=180)
+            results[r] = err
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    errs = {r: e for r, e in results.items() if e}
+    assert not errs, f"worker failures: {errs}"
+
+
+def _worker_rank_stamp(rank, world, tmp, q):
+    try:
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            shard = np.full((NUM, DIM), rank + 1, np.float64)
+            s.add("data", shard)
+            s.add("labels", np.full((NUM,), rank + 1, np.int32))
+            assert s.total_rows("data") == world * NUM
+
+            rng = np.random.default_rng(rank)
+            # Single gets (remote and local).
+            for _ in range(10):
+                idx = int(rng.integers(0, world * NUM))
+                row = s.get("data", idx)[0]
+                assert row.mean() == idx // NUM + 1, (idx, row.mean())
+                assert s.get("labels", idx)[0] == idx // NUM + 1
+
+            # Batched scattered gets hitting all peers.
+            idx = rng.integers(0, world * NUM, size=256)
+            batch = s.get_batch("data", idx)
+            np.testing.assert_array_equal(batch.mean(axis=1),
+                                          (idx // NUM + 1).astype(np.float64))
+
+            # Contiguous multi-row get from one remote peer.
+            peer = (rank + 1) % world
+            rows = s.get("data", peer * NUM + 2, 5)
+            assert rows.shape == (5, DIM)
+            assert (rows == peer + 1).all()
+        q.put((rank, None))
+    except BaseException as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
+def _worker_epochs(rank, world, tmp, q):
+    try:
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp", epoch_collective=True) as s:
+            s.add("v", np.full((NUM, DIM), rank + 1, np.float64))
+            rng = np.random.default_rng(1234)  # same stream on all ranks
+            for _ in range(4):
+                s.epoch_begin()
+                for _ in range(8):
+                    idx = int(rng.integers(0, world * NUM))
+                    assert s.get("v", idx)[0].mean() == idx // NUM + 1
+                s.epoch_end()
+        q.put((rank, None))
+    except BaseException as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
+def _worker_update(rank, world, tmp, q):
+    try:
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            s.init("v", NUM, (DIM,), np.float32)
+            s.update("v", np.full((NUM, DIM), rank + 1, np.float32))
+            s.barrier()
+            peer = (rank + world - 1) % world
+            got = s.get("v", peer * NUM + 3)[0]
+            assert (got == peer + 1).all()
+            s.barrier()
+        q.put((rank, None))
+    except BaseException as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
+def _worker_width(rank, world, tmp, q):
+    try:
+        from ddstore_tpu import DDStore, FileGroup
+
+        width = world // 2
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp", width=width) as s:
+            assert s.world == width
+            s.add("v", np.full((NUM, DIM), s.rank + 1, np.float64))
+            assert s.total_rows("v") == width * NUM
+            for idx in range(0, width * NUM, NUM):
+                assert s.get("v", idx)[0].mean() == idx // NUM + 1
+        q.put((rank, None))
+    except BaseException as e:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_tcp_rank_stamp(world, tmp_path):
+    _spawn(world, _worker_rank_stamp, str(tmp_path))
+
+
+def test_tcp_collective_epochs(tmp_path):
+    _spawn(3, _worker_epochs, str(tmp_path))
+
+
+def test_tcp_init_update(tmp_path):
+    _spawn(2, _worker_update, str(tmp_path))
+
+
+def test_tcp_replica_width(tmp_path):
+    _spawn(4, _worker_width, str(tmp_path))

@@ -1,0 +1,182 @@
+"""Multi-rank store tests with ranks as threads on the in-process transport
+— the deterministic fake backend for covering global index math, remote
+reads, batching, epochs, and replica-width groups without processes or
+sockets."""
+
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStore, ThreadGroup
+
+
+def run_ranks(world, fn):
+    """Run fn(rank, group) on `world` threads; re-raise the first failure."""
+    name = uuid.uuid4().hex
+    errors = [None] * world
+    results = [None] * world
+
+    def runner(r):
+        try:
+            results[r] = fn(r, ThreadGroup(name, r, world))
+        except BaseException as e:  # noqa: BLE001
+            errors[r] = e
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+NUM, DIM = 16, 8
+
+
+def rank_stamp_shard(rank, num=NUM, dim=DIM, dtype=np.float64):
+    # The reference's correctness oracle (test/demo.py:37): rank r's shard
+    # is all (r+1), so any fetched row betrays its true owner.
+    return np.full((num, dim), rank + 1, dtype=dtype)
+
+
+class TestThreadedStore:
+    @pytest.mark.parametrize("world", [2, 4, 7])
+    def test_rank_stamp_remote_get(self, world):
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                s.add("data", rank_stamp_shard(rank))
+                assert s.total_rows("data") == world * NUM
+                rng = np.random.default_rng(100 + rank)
+                for _ in range(20):
+                    idx = int(rng.integers(0, world * NUM))
+                    row = s.get("data", idx)[0]
+                    owner = idx // NUM
+                    assert row.mean() == owner + 1  # oracle
+        run_ranks(world, body)
+
+    def test_rank_stamp_get_batch(self):
+        world = 4
+
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                s.add("data", rank_stamp_shard(rank))
+                rng = np.random.default_rng(rank)
+                idx = rng.integers(0, world * NUM, size=64)
+                batch = s.get_batch("data", idx)
+                expect = (idx // NUM + 1).astype(np.float64)
+                np.testing.assert_array_equal(batch.mean(axis=1), expect)
+        run_ranks(world, body)
+
+    def test_uneven_shards(self):
+        # Ranks own different row counts; global index math must follow the
+        # allgathered cumulative table (reference requires uniform disp but
+        # allows uneven nrows, ddstore.hpp:75-89).
+        world = 3
+        counts = [5, 0, 9]  # includes an empty shard
+
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                n = counts[rank]
+                shard = np.full((n, 4), rank + 1, np.float32)
+                s.add("v", shard)
+                total = sum(counts)
+                assert s.total_rows("v") == total
+                cum = np.cumsum(counts)
+                for idx in range(total):
+                    owner = int(np.searchsorted(cum, idx, side="right"))
+                    assert s.get("v", idx)[0].mean() == owner + 1
+        run_ranks(world, body)
+
+    def test_two_variables(self):
+        # Two named variables with different shapes/dtypes (reference
+        # test.py:135-136 uses two vars).
+        world = 2
+
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                s.add("data", rank_stamp_shard(rank, dtype=np.float32))
+                s.add("labels", np.full((NUM,), rank + 1, np.int64))
+                for idx in [0, NUM, 2 * NUM - 1]:
+                    owner = idx // NUM
+                    assert s.get("data", idx)[0].mean() == owner + 1
+                    assert s.get("labels", idx)[0] == owner + 1
+        run_ranks(world, body)
+
+    def test_cross_shard_get_rejected(self):
+        world = 2
+
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                s.add("v", rank_stamp_shard(rank))
+                from ddstore_tpu import DDStoreError
+                with pytest.raises(DDStoreError):
+                    s.get("v", NUM - 1, 2)  # spans the shard boundary
+        run_ranks(world, body)
+
+    def test_collective_epoch_fences(self):
+        # Collective mode: every rank must reach begin/end — the reference's
+        # per-batch fence semantics (src/ddstore.cxx:51-77).
+        world = 4
+
+        def body(rank, group):
+            with DDStore(group, backend="local",
+                         epoch_collective=True) as s:
+                s.add("v", rank_stamp_shard(rank))
+                for _ in range(5):
+                    s.epoch_begin()
+                    idx = (rank * 31) % (world * NUM)
+                    assert s.get("v", idx)[0].mean() == idx // NUM + 1
+                    s.epoch_end()
+        run_ranks(world, body)
+
+    def test_barrier(self):
+        world = 4
+        counter = {"v": 0}
+        lock = threading.Lock()
+
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                s.add("v", rank_stamp_shard(rank))
+                with lock:
+                    counter["v"] += 1
+                s.barrier()
+                # After the barrier every rank must have incremented.
+                assert counter["v"] == world
+        run_ranks(world, body)
+
+    def test_update_visible_remotely(self):
+        world = 2
+
+        def body(rank, group):
+            with DDStore(group, backend="local") as s:
+                s.init("v", NUM, (DIM,), np.float64)
+                s.update("v", rank_stamp_shard(rank), 0)
+                s.barrier()
+                peer = 1 - rank
+                assert s.get("v", peer * NUM)[0].mean() == peer + 1
+                s.barrier()
+        run_ranks(world, body)
+
+    def test_replica_width_groups(self):
+        # width=2 over 4 ranks → two replica groups, each holding a full
+        # copy; fetch traffic stays inside the group (reference
+        # README.md:154-172 / distdataset.py:25-30, promoted to the core).
+        world, width = 4, 2
+
+        def body(rank, group):
+            with DDStore(group, backend="local", width=width) as s:
+                assert s.world == width
+                assert s.replica_id == rank // width
+                assert s.num_replicas == 2
+                # Each group member stamps with its group-local rank.
+                s.add("v", rank_stamp_shard(s.rank))
+                assert s.total_rows("v") == width * NUM
+                for idx in range(0, width * NUM, NUM // 2):
+                    assert s.get("v", idx)[0].mean() == idx // NUM + 1
+        run_ranks(world, body)
