@@ -1,0 +1,6 @@
+"""Dataset adapters and device-feeding loaders over the store."""
+
+from .dataset import DistributedSampler, ShardedDataset
+from .loader import DeviceLoader
+
+__all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader"]

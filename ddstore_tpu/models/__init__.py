@@ -1,0 +1,16 @@
+"""Model families with sharded train steps.
+
+* ``vae`` — the flagship: MNIST-scale VAE matching the reference's DDP
+  example model (examples/vae/vae-ddp.py:174-200), trained data-parallel
+  under jit with NamedShardings (no torch, no NCCL).
+* ``gnn`` — message-passing GNN for molecular property regression
+  (QM9-class workloads, the reference's HydraGNN use case and
+  BASELINE.json configs 3-5).
+* ``transformer`` — long-context transformer using ring attention over a
+  sequence-parallel mesh axis (value-add; SURVEY §2.2 lists SP/CP as
+  absent in the reference).
+"""
+
+from . import vae  # noqa: F401
+
+__all__ = ["vae"]

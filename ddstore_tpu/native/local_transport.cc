@@ -73,11 +73,9 @@ int LocalTransport::Read(int target, const std::string& name, int64_t offset,
                          int64_t nbytes, void* dst) {
   Store* peer = group_->member(target);
   if (!peer) return kErrTransport;
-  VarInfo v;
-  if (!peer->GetVarInfo(name, &v)) return kErrNotFound;
-  if (offset < 0 || offset + nbytes > v.shard_bytes()) return kErrOutOfRange;
-  std::memcpy(dst, v.base + offset, nbytes);
-  return kOk;
+  // ReadLocal holds the peer's read lock across the copy, so a concurrent
+  // FreeVar on the peer cannot free the shard mid-read.
+  return peer->ReadLocal(name, offset, nbytes, dst);
 }
 
 }  // namespace dds

@@ -43,7 +43,7 @@ int Store::AddInternal(const std::string& name, const void* buf, int64_t nrows,
                        const int64_t* all_nrows, bool copy, bool zero_fill) {
   if (name.empty() || disp <= 0 || itemsize <= 0 || nrows < 0)
     return kErrInvalidArg;
-  std::lock_guard<std::mutex> lock(mu_);
+  std::unique_lock<std::shared_mutex> lock(mu_);
   if (vars_.count(name)) return kErrExists;
 
   VarInfo v;
@@ -97,7 +97,7 @@ int Store::Init(const std::string& name, int64_t nrows, int64_t disp,
 int Store::Update(const std::string& name, const void* buf, int64_t nrows,
                   int64_t row_offset) {
   if (!buf || nrows < 0 || row_offset < 0) return kErrInvalidArg;
-  std::lock_guard<std::mutex> lock(mu_);
+  std::unique_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
   VarInfo& v = it->second;
@@ -123,10 +123,7 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
 
   int64_t offset = (start - shard_begin) * v.row_bytes();
   int64_t nbytes = count * v.row_bytes();
-  if (target == rank()) {
-    std::memcpy(dst, v.base + offset, nbytes);
-    return kOk;
-  }
+  if (target == rank()) return ReadLocal(name, offset, nbytes, dst);
   return transport_->Read(target, name, offset, nbytes, dst);
 }
 
@@ -176,7 +173,8 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   char* out = static_cast<char*>(dst);
   for (const Run& r : runs) {
     if (r.target == rank()) {
-      std::memcpy(out + r.dst_off, v.base + r.offset, r.nbytes);
+      int rc = ReadLocal(name, r.offset, r.nbytes, out + r.dst_off);
+      if (rc != kOk) return rc;
     } else {
       by_peer[r.target].push_back(ReadOp{r.offset, r.nbytes, out + r.dst_off});
     }
@@ -214,7 +212,7 @@ int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,
 
 int Store::EpochBegin() {
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    std::unique_lock<std::shared_mutex> lock(mu_);
     if (fence_active_) return kErrEpochState;
     fence_active_ = true;
     ++epoch_tag_;
@@ -226,7 +224,7 @@ int Store::EpochBegin() {
 
 int Store::EpochEnd() {
   {
-    std::lock_guard<std::mutex> lock(mu_);
+    std::unique_lock<std::shared_mutex> lock(mu_);
     if (!fence_active_) return kErrEpochState;
     fence_active_ = false;
   }
@@ -236,7 +234,7 @@ int Store::EpochEnd() {
 }
 
 int Store::FreeVar(const std::string& name) {
-  std::lock_guard<std::mutex> lock(mu_);
+  std::unique_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
   if (it->second.owned) ::free(it->second.base);
@@ -245,7 +243,7 @@ int Store::FreeVar(const std::string& name) {
 }
 
 int Store::FreeAll() {
-  std::lock_guard<std::mutex> lock(mu_);
+  std::unique_lock<std::shared_mutex> lock(mu_);
   for (auto& kv : vars_)
     if (kv.second.owned) ::free(kv.second.base);
   vars_.clear();
@@ -258,13 +256,25 @@ int Store::Barrier(int64_t tag) {
 }
 
 char* Store::LocalBase(const std::string& name) const {
-  std::lock_guard<std::mutex> lock(mu_);
+  std::shared_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   return it == vars_.end() ? nullptr : it->second.base;
 }
 
+int Store::ReadLocal(const std::string& name, int64_t offset,
+                     int64_t nbytes, void* dst) const {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  const VarInfo& v = it->second;
+  if (offset < 0 || nbytes < 0 || offset + nbytes > v.shard_bytes())
+    return kErrOutOfRange;
+  std::memcpy(dst, v.base + offset, nbytes);
+  return kOk;
+}
+
 bool Store::GetVarInfo(const std::string& name, VarInfo* out) const {
-  std::lock_guard<std::mutex> lock(mu_);
+  std::shared_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   if (it == vars_.end()) return false;
   *out = it->second;  // copies metadata; base pointer stays valid until free

@@ -22,6 +22,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <shared_mutex>
 #include <string>
 #include <vector>
 
@@ -179,12 +180,21 @@ class Store {
   // Snapshot of variable metadata (for the serving thread).
   bool GetVarInfo(const std::string& name, VarInfo* out) const;
 
+  // Copy `nbytes` at byte offset `offset` of the LOCAL shard of `name` into
+  // dst, holding the read lock across the copy — the only safe way for
+  // transports/serving threads to touch shard memory (a metadata snapshot's
+  // base pointer could be freed by a concurrent FreeVar).
+  int ReadLocal(const std::string& name, int64_t offset, int64_t nbytes,
+                void* dst) const;
+
  private:
   int AddInternal(const std::string& name, const void* buf, int64_t nrows,
                   int64_t disp, int64_t itemsize, const int64_t* all_nrows,
                   bool copy, bool zero_fill);
 
-  mutable std::mutex mu_;
+  // Readers (gets, serving threads) take shared; add/init/update/free take
+  // exclusive, so shard memory can't be freed or overwritten mid-read.
+  mutable std::shared_mutex mu_;
   std::map<std::string, VarInfo> vars_;
   std::unique_ptr<Transport> transport_;
   bool fence_active_ = false;

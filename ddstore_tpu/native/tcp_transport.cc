@@ -108,13 +108,14 @@ TcpTransport::~TcpTransport() {
     ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
   }
-  {
-    std::lock_guard<std::mutex> lock(conns_mu_);
-    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
-  }
+  // Join the accept loop FIRST so conn_fds_ can no longer grow; only then
+  // shut the (now-stable) set of connection fds down and join handlers —
+  // otherwise a connection accepted mid-teardown would miss its shutdown
+  // and its handler thread would block join() forever in recv.
   if (accept_thread_.joinable()) accept_thread_.join();
   {
     std::lock_guard<std::mutex> lock(conns_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
     for (auto& t : conn_threads_)
       if (t.joinable()) t.join();
     for (int fd : conn_fds_) ::close(fd);
@@ -157,6 +158,7 @@ void TcpTransport::AcceptLoop() {
 
 void TcpTransport::HandleConnection(int fd) {
   std::string name;
+  std::vector<char> scratch;
   while (!stopping_.load()) {
     WireReq req;
     if (FullRecv(fd, &req, sizeof(req)) != 0) return;
@@ -176,21 +178,24 @@ void TcpTransport::HandleConnection(int fd) {
     }
     if (req.op != kOpRead) return;
 
+    // Copy into the connection's scratch under the store's read lock (a
+    // concurrent FreeVar must not free the shard mid-serve), then send
+    // outside the lock.
     WireResp resp{kOk, 0, 0};
-    VarInfo v;
-    if (!store_ || !store_->GetVarInfo(name, &v)) {
+    if (!store_) {
       resp.status = kErrNotFound;
-    } else if (req.offset < 0 || req.nbytes < 0 ||
-               req.offset + req.nbytes > v.shard_bytes()) {
-      resp.status = kErrOutOfRange;
     } else {
-      resp.nbytes = req.nbytes;
+      if (req.nbytes > 0 &&
+          static_cast<int64_t>(scratch.size()) < req.nbytes)
+        scratch.resize(static_cast<size_t>(req.nbytes));
+      int rc = store_->ReadLocal(name, req.offset, req.nbytes,
+                                 scratch.data());
+      if (rc != kOk) resp.status = rc;
+      else resp.nbytes = req.nbytes;
     }
     if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
     if (resp.status == kOk && resp.nbytes > 0) {
-      // Serve straight from the shard: no copy, no registration churn.
-      if (FullSend(fd, v.base + req.offset,
-                   static_cast<size_t>(resp.nbytes)) != 0)
+      if (FullSend(fd, scratch.data(), static_cast<size_t>(resp.nbytes)) != 0)
         return;
     }
   }

@@ -1,0 +1,22 @@
+"""JAX parallelism layer: meshes, sharding helpers, collectives, the
+epoch-wise global shuffle, and ring attention for sequence parallelism.
+
+This layer has no counterpart in the reference (its device-side parallelism
+is delegated entirely to torch DDP/NCCL, SURVEY §2.2); it is the TPU-native
+value-add that connects the host-side store to device meshes.
+"""
+
+from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
+                   make_mesh, replicate)
+from .shuffle import all_to_all_rows, global_shuffle_epoch, permute_rows
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_mesh",
+    "local_mesh",
+    "batch_sharding",
+    "replicate",
+    "all_to_all_rows",
+    "permute_rows",
+    "global_shuffle_epoch",
+]

@@ -1,0 +1,110 @@
+"""Epoch-wise global shuffle.
+
+Two paths, matching the BASELINE.json north star ("the per-epoch global
+shuffle lowers to jax.lax.all_to_all over ICI"):
+
+* **Device path** — for device-resident datasets: a fixed-shape, jit-stable
+  shuffle built from (local permutation) ∘ (all_to_all block exchange) ∘
+  (local permutation) under ``shard_map``. Shapes are static, so XLA
+  compiles it once and reuses it every epoch; every row can land on every
+  shard across epochs.
+
+* **Host path** — for store-resident datasets: an arbitrary global
+  permutation executed as a one-sided reshard through the store (each rank
+  batch-fetches the rows the permutation assigns it, then atomically
+  replaces its shard). This is the capability the reference's SC'23 paper
+  attributes to ``MPI_Alltoallv`` but which is absent from the reference
+  snapshot (verified, SURVEY §2.2) — implemented here as a target
+  capability.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def all_to_all_rows(x: jax.Array, mesh: Mesh, axis: str = "dp") -> jax.Array:
+    """Block exchange over `axis`: each shard splits its rows into
+    `world` equal blocks and sends block j to peer j (a row-space
+    transpose). Local row count must be divisible by the axis size."""
+
+    def body(xs):
+        world = jax.lax.psum(1, axis)
+        blocks = xs.reshape((world, xs.shape[0] // world) + xs.shape[1:])
+        out = jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        return out.reshape(xs.shape)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                         out_specs=P(axis))(x)
+
+
+@partial(jax.jit, static_argnames=("mesh", "axis"))
+def global_shuffle_epoch(x: jax.Array, key: jax.Array, *, mesh: Mesh,
+                         axis: str = "dp") -> jax.Array:
+    """Device-resident global shuffle with static shapes (compiles once,
+    reused every epoch).
+
+    local-perm ∘ all_to_all ∘ local-perm: the inner exchange moves every
+    j-th block of every shard to shard j; the outer permutations are
+    independent per shard and per epoch (key folded with the shard index),
+    so the composition mixes rows across the whole global index space.
+    """
+
+    def body(xs, k):
+        idx = jax.lax.axis_index(axis)
+        world = jax.lax.psum(1, axis)
+        k1, k2 = jax.random.split(jax.random.fold_in(k, idx))
+        n = xs.shape[0]
+        xs = jnp.take(xs, jax.random.permutation(k1, n), axis=0)
+        blocks = xs.reshape((world, n // world) + xs.shape[1:])
+        blocks = jax.lax.all_to_all(blocks, axis, split_axis=0,
+                                    concat_axis=0, tiled=False)
+        xs = blocks.reshape(xs.shape)
+        # Second local permutation must differ across shards but not
+        # correlate with the first; fold in world+idx.
+        k3 = jax.random.fold_in(k2, world + idx)
+        return jnp.take(xs, jax.random.permutation(k3, n), axis=0)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))(x, key)
+
+
+def permute_rows(x: jax.Array, perm: jax.Array, mesh: Mesh,
+                 axis: str = "dp") -> jax.Array:
+    """Arbitrary global row permutation of a device-sharded array:
+    ``out[i] = x[perm[i]]``. Implemented as a sharded gather — XLA lowers
+    the cross-shard movement to collectives over ICI. Use
+    :func:`global_shuffle_epoch` when any good shuffle will do (cheaper);
+    use this when the exact permutation matters."""
+    sharding = NamedSharding(mesh, P(axis))
+    taken = jnp.take(x, perm, axis=0)
+    return jax.lax.with_sharding_constraint(taken, sharding)
+
+
+def host_global_shuffle(store, name: str, seed: int,
+                        rng: Optional[np.random.Generator] = None) -> None:
+    """Host-path global shuffle of a store variable, in place.
+
+    Every rank computes the same seeded global permutation, batch-fetches
+    the rows assigned to its shard (coalesced one-sided reads over the
+    transport), waits at a barrier so all fetches complete against the OLD
+    data, then atomically overwrites its shard. Collective: all ranks must
+    call with the same seed.
+    """
+    info = store.query(name)
+    total = info["total_rows"]
+    begin, end = store.my_row_range(name)
+    g = rng or np.random.default_rng(seed)
+    perm = g.permutation(total)
+    mine = perm[begin:end]
+    fresh = store.get_batch(name, mine)     # reads see old data
+    store.barrier()                          # everyone done reading
+    store.update(name, fresh, 0)             # then everyone swaps
+    store.barrier()

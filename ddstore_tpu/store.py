@@ -190,7 +190,7 @@ class DDStore:
         distinct peers in parallel — the batched fetch path the reference
         lacks (it issues one blocking get per sample, SURVEY §3.2)."""
         m = self._require(name)
-        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        idx = np.ascontiguousarray(indices, dtype=np.int64).reshape(-1)
         out = self._check_out(name, m, out, len(idx))
         self._native.get_batch(name, out, idx)
         return out

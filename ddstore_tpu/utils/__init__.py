@@ -1,0 +1,5 @@
+"""Metrics, logging, and small helpers."""
+
+from .metrics import LatencyHistogram, PipelineMetrics
+
+__all__ = ["LatencyHistogram", "PipelineMetrics"]

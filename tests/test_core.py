@@ -145,6 +145,14 @@ class TestSingleProcessStore:
             assert q["itemsize"] == 2
             assert q["sample_shape"] == (3, 2)
 
+    def test_get_batch_2d_indices_flattened(self, rng):
+        # Multi-dim index arrays are flattened, never silently truncated.
+        with make_store() as s:
+            data = rng.standard_normal((16, 3)).astype(np.float32)
+            s.add("x", data)
+            got = s.get_batch("x", [[0, 1], [5, 3]])
+            np.testing.assert_array_equal(got, data[[0, 1, 5, 3]])
+
     def test_out_validation(self, rng):
         # The native core writes count*row_bytes blindly; a wrong out buffer
         # must be rejected, never coerced (heap-safety regression test).

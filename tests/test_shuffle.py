@@ -1,0 +1,101 @@
+"""Global shuffle tests: device path (shard_map + lax.all_to_all on the
+virtual 8-device mesh) and host path (one-sided reshard through the store)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddstore_tpu.parallel import (all_to_all_rows, global_shuffle_epoch,
+                                  make_mesh, permute_rows)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"dp": 8})
+
+
+class TestDeviceShuffle:
+    def test_all_to_all_rows_is_permutation(self, mesh):
+        x = jnp.arange(64 * 3, dtype=jnp.float32).reshape(64, 3)
+        xs = jax.device_put(x, jax.NamedSharding(mesh, jax.P("dp")))
+        y = all_to_all_rows(xs, mesh)
+        assert sorted(np.asarray(y)[:, 0].tolist()) == \
+            sorted(np.asarray(x)[:, 0].tolist())
+        # Block j of shard i lands on shard j: row 0 of shard 1 (global row
+        # 8) must now live in shard 0's region.
+        ynp = np.asarray(y)
+        assert ynp[1, 0] == x[8, 0]
+
+    def test_global_shuffle_is_permutation(self, mesh):
+        x = jnp.arange(128, dtype=jnp.float32).reshape(128, 1)
+        xs = jax.device_put(x, jax.NamedSharding(mesh, jax.P("dp")))
+        key = jax.random.key(0)
+        y = global_shuffle_epoch(xs, key, mesh=mesh)
+        assert sorted(np.asarray(y).ravel().tolist()) == list(range(128))
+
+    def test_global_shuffle_mixes_across_shards(self, mesh):
+        # After one shuffle, each shard must hold rows from several source
+        # shards (not merely a local reorder).
+        n = 128
+        x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
+        xs = jax.device_put(x, jax.NamedSharding(mesh, jax.P("dp")))
+        y = np.asarray(global_shuffle_epoch(xs, jax.random.key(1), mesh=mesh))
+        per_shard = n // 8
+        for s in range(8):
+            src_shards = set((y[s * per_shard:(s + 1) * per_shard, 0] //
+                              per_shard).astype(int).tolist())
+            assert len(src_shards) == 8  # every source represented
+
+    def test_different_keys_different_orders(self, mesh):
+        x = jnp.arange(128, dtype=jnp.float32).reshape(128, 1)
+        xs = jax.device_put(x, jax.NamedSharding(mesh, jax.P("dp")))
+        y1 = np.asarray(global_shuffle_epoch(xs, jax.random.key(1), mesh=mesh))
+        y2 = np.asarray(global_shuffle_epoch(xs, jax.random.key(2), mesh=mesh))
+        assert not np.array_equal(y1, y2)
+
+    def test_permute_rows_exact(self, mesh):
+        x = jnp.arange(64 * 2, dtype=jnp.float32).reshape(64, 2)
+        xs = jax.device_put(x, jax.NamedSharding(mesh, jax.P("dp")))
+        perm = jax.random.permutation(jax.random.key(3), 64)
+        y = permute_rows(xs, perm, mesh)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x)[perm])
+
+
+class TestHostShuffle:
+    def test_threaded_host_shuffle(self):
+        import threading
+        import uuid
+
+        from ddstore_tpu import DDStore, ThreadGroup
+        from ddstore_tpu.parallel.shuffle import host_global_shuffle
+
+        world, num, dim = 4, 16, 4
+        name = uuid.uuid4().hex
+        errors = []
+        collected = [None] * world
+
+        def body(rank):
+            try:
+                g = ThreadGroup(name, rank, world)
+                with DDStore(g, backend="local") as s:
+                    shard = (np.arange(num) + rank * num).astype(
+                        np.float64).reshape(num, 1) * np.ones((1, dim))
+                    s.add("v", shard)
+                    host_global_shuffle(s, "v", seed=99)
+                    collected[rank] = s.get("v", rank * num, num).copy()
+                    s.barrier()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert not errors, errors
+        allrows = np.concatenate(collected)[:, 0]
+        # Exactly the expected permutation of the global row ids.
+        perm = np.random.default_rng(99).permutation(world * num)
+        np.testing.assert_array_equal(allrows, perm.astype(np.float64))

@@ -1,0 +1,77 @@
+"""Flagship VAE: sharded train step correctness + store-fed end-to-end
+training on the 8-device virtual mesh (loss must decrease — the reference's
+only model-level oracle, its example prints falling loss)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ddstore_tpu import DDStore, SingleGroup
+from ddstore_tpu.data import DeviceLoader, DistributedSampler, ShardedDataset
+from ddstore_tpu.models import vae
+from ddstore_tpu.parallel import make_mesh
+
+
+def test_forward_shapes():
+    model = vae.VAE()
+    params = model.init(jax.random.key(0), jnp.zeros((4, 784)),
+                        jax.random.key(1))
+    logits, mu, logvar = model.apply(params, jnp.zeros((4, 784)),
+                                     jax.random.key(2))
+    assert logits.shape == (4, 784)
+    assert mu.shape == logvar.shape == (4, 20)
+
+
+def test_dp_step_matches_single_device():
+    # The sharded step must compute the same loss/params as an unsharded
+    # one — XLA's inserted allreduce is numerically the same sum.
+    mesh = make_mesh({"dp": 8})
+    model, state_m, tx = vae.create_train_state(jax.random.key(0), mesh=mesh)
+    _, state_s, _ = vae.create_train_state(jax.random.key(0))
+    step_m = vae.make_train_step(model, tx, mesh=mesh, donate=False)
+    step_s = vae.make_train_step(model, tx, donate=False)
+
+    batch = jax.random.uniform(jax.random.key(3), (16, 784))
+    key = jax.random.key(4)
+    new_m, loss_m = step_m(state_m, jax.device_put(
+        batch, jax.NamedSharding(mesh, jax.P("dp"))), key)
+    new_s, loss_s = step_s(state_s, batch, key)
+    np.testing.assert_allclose(float(loss_m), float(loss_s), rtol=2e-4)
+    flat_m = jax.tree_util.tree_leaves(new_m.params)
+    flat_s = jax.tree_util.tree_leaves(new_s.params)
+    for a, b in zip(flat_m, flat_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_store_fed_training_loss_decreases():
+    mesh = make_mesh({"dp": 8})
+    g = np.random.default_rng(0)
+    centers = g.random((10, 784), dtype=np.float32)
+    labels = g.integers(0, 10, size=512).astype(np.int32)
+    data = (centers[labels] * 0.8 + 0.2 *
+            g.random((512, 784), dtype=np.float32)).astype(np.float32)
+
+    with DDStore(SingleGroup(), backend="local") as store:
+        ds = ShardedDataset(store, data, labels)
+        model, state, tx = vae.create_train_state(jax.random.key(0),
+                                                  mesh=mesh)
+        step = vae.make_train_step(model, tx, mesh=mesh)
+        sampler = DistributedSampler(len(ds), 1, 0, seed=0)
+        key = jax.random.key(1)
+        losses = []
+        for epoch in range(3):
+            sampler.set_epoch(epoch)
+            loader = DeviceLoader(ds, sampler, batch_size=64, mesh=mesh,
+                                  transform=lambda b: b[0])
+            tot = 0.0
+            for xb in loader:
+                key, sub = jax.random.split(key)
+                state, loss = step(state, xb, sub)
+                tot += float(loss)
+            losses.append(tot)
+        # BCE against continuous targets has a high floor; require steady
+        # per-epoch improvement, not a specific ratio.
+        assert losses[2] < losses[1] < losses[0], losses
+        assert losses[-1] < losses[0] * 0.99, losses
+        eff = loader.metrics.summary()["input_pipeline_efficiency"]
+        assert 0.0 <= eff <= 1.0
