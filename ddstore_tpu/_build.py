@@ -37,6 +37,11 @@ def build(force: bool = False) -> str:
     with _lock:
         if not force and not _stale():
             return _LIB_PATH
+        # Installed wheels bundle the library (setup.py build_native); the
+        # site-packages tree may be read-only, so fall back to the bundled
+        # lib rather than insisting on a rebuild.
+        if os.path.exists(_LIB_PATH) and not os.access(_LIB_DIR, os.W_OK):
+            return _LIB_PATH
         os.makedirs(_LIB_DIR, exist_ok=True)
         cxx = os.environ.get("DDSTORE_CXX", "g++")
         cmd = [

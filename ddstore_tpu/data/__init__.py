@@ -1,6 +1,13 @@
 """Dataset adapters and device-feeding loaders over the store."""
 
 from .dataset import DistributedSampler, ShardedDataset
+from .graphs import (GraphBatch, GraphSample, GraphShardedDataset,
+                     pack_graph_batch, synthetic_graphs)
 from .loader import DeviceLoader
+from .ragged import (pack_ragged, pad_ragged, segment_ids_from_lengths,
+                     split_ragged)
 
-__all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader"]
+__all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader",
+           "pad_ragged", "pack_ragged", "split_ragged",
+           "segment_ids_from_lengths", "GraphBatch", "GraphSample",
+           "GraphShardedDataset", "pack_graph_batch", "synthetic_graphs"]

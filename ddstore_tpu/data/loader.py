@@ -96,8 +96,8 @@ class DeviceLoader:
         with self.metrics.stage.timed():
             put = lambda x: jax.make_array_from_process_local_data(
                 self._sharding, np.ascontiguousarray(x))
-            if isinstance(batch, tuple):
-                return tuple(put(x) for x in batch)
+            # tree_map preserves container types (tuples, NamedTuple
+            # batches like GraphBatch, dicts) while staging every leaf.
             return jax.tree_util.tree_map(put, batch)
 
     def __iter__(self):
@@ -107,14 +107,28 @@ class DeviceLoader:
         SENTINEL = object()
 
         def producer():
+            def put(item):
+                # A plain q.put can block forever if the consumer broke out
+                # (e.g. a step cap) after the final drain — check stop while
+                # waiting so the thread always exits and never races a
+                # store teardown with an in-flight fetch.
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
             try:
                 for idx in self._index_batches():
                     if stop.is_set():
                         return
-                    q.put(self._fetch(idx))
-                q.put(SENTINEL)
+                    if not put(self._fetch(idx)):
+                        return
+                put(SENTINEL)
             except BaseException as e:  # surface in consumer
-                q.put(e)
+                put(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

@@ -11,6 +11,6 @@
   absent in the reference).
 """
 
-from . import vae  # noqa: F401
+from . import gnn, vae  # noqa: F401
 
-__all__ = ["vae"]
+__all__ = ["vae", "gnn"]

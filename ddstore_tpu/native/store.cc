@@ -273,6 +273,17 @@ int Store::ReadLocal(const std::string& name, int64_t offset,
   return kOk;
 }
 
+int Store::CheckLocal(const std::string& name, int64_t offset,
+                      int64_t nbytes) const {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  const VarInfo& v = it->second;
+  if (offset < 0 || nbytes < 0 || offset + nbytes > v.shard_bytes())
+    return kErrOutOfRange;
+  return kOk;
+}
+
 bool Store::GetVarInfo(const std::string& name, VarInfo* out) const {
   std::shared_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);

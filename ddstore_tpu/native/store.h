@@ -187,6 +187,13 @@ class Store {
   int ReadLocal(const std::string& name, int64_t offset, int64_t nbytes,
                 void* dst) const;
 
+  // Validate a prospective ReadLocal without touching memory. Serving
+  // threads call this BEFORE sizing their scratch buffer, so a corrupt or
+  // hostile request length is answered with an error code instead of an
+  // allocation attempt.
+  int CheckLocal(const std::string& name, int64_t offset,
+                 int64_t nbytes) const;
+
  private:
   int AddInternal(const std::string& name, const void* buf, int64_t nrows,
                   int64_t disp, int64_t itemsize, const int64_t* all_nrows,

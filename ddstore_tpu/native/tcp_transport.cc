@@ -10,6 +10,7 @@
 #include <cerrno>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 namespace dds {
@@ -71,6 +72,14 @@ int FullRecv(int fd, void* buf, size_t n) {
 void SetNoDelay(int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// DDSTORE_DEBUG=1 narrates barrier traffic to stderr (control-plane bugs
+// across processes are otherwise invisible — the reference's equivalent
+// pain point is its commented-out printf debugging, ddstore.hpp:90-94).
+bool DebugOn() {
+  static const bool on = ::getenv("DDSTORE_DEBUG") != nullptr;
+  return on;
 }
 
 }  // namespace
@@ -167,13 +176,20 @@ void TcpTransport::HandleConnection(int fd) {
     if (req.name_len && FullRecv(fd, &name[0], req.name_len) != 0) return;
 
     if (req.op == kOpBarrier) {
+      // One-way: no response. An acked design deadlocks at teardown — a
+      // rank that passes the barrier may close before acking, failing the
+      // late peer's notify loop midway so the remaining peers never get
+      // notified and wait out the full timeout.
       {
         std::lock_guard<std::mutex> lock(barrier_mu_);
         ++barrier_arrived_[req.tag];
+        if (DebugOn())
+          std::fprintf(stderr, "[dds r%d] barrier notify from r%d tag=%lld "
+                       "count=%lld\n", rank_, req.src,
+                       static_cast<long long>(req.tag),
+                       static_cast<long long>(barrier_arrived_[req.tag]));
       }
       barrier_cv_.notify_all();
-      WireResp resp{kOk, 0, 0};
-      if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
       continue;
     }
     if (req.op != kOpRead) return;
@@ -185,11 +201,16 @@ void TcpTransport::HandleConnection(int fd) {
     if (!store_) {
       resp.status = kErrNotFound;
     } else {
-      if (req.nbytes > 0 &&
-          static_cast<int64_t>(scratch.size()) < req.nbytes)
-        scratch.resize(static_cast<size_t>(req.nbytes));
-      int rc = store_->ReadLocal(name, req.offset, req.nbytes,
-                                 scratch.data());
+      // Validate the request BEFORE sizing scratch: a corrupt/oversized
+      // nbytes must produce an error frame, not a terminate() from a
+      // failed allocation in this serving thread.
+      int rc = store_->CheckLocal(name, req.offset, req.nbytes);
+      if (rc == kOk) {
+        if (req.nbytes > 0 &&
+            static_cast<int64_t>(scratch.size()) < req.nbytes)
+          scratch.resize(static_cast<size_t>(req.nbytes));
+        rc = store_->ReadLocal(name, req.offset, req.nbytes, scratch.data());
+      }
       if (rc != kOk) resp.status = rc;
       else resp.nbytes = req.nbytes;
     }
@@ -292,25 +313,47 @@ int TcpTransport::ReadV(int target, const std::string& name, const ReadOp* ops,
 }
 
 int TcpTransport::Barrier(int64_t tag) {
-  // Notify every peer, then wait until every peer has notified us.
+  // Notify every peer (one-way, best-effort), then wait until every peer
+  // has notified us. Notify failures are not immediately fatal: the common
+  // benign case is a peer that already passed this barrier and tore down —
+  // its own notify to us was delivered before it exited. A peer that truly
+  // died early can never notify us, and the wait timeout surfaces that as
+  // kErrTransport (failure detection; the reference has none, SURVEY §5).
   for (int r = 0; r < world_; ++r) {
     if (r == rank_) continue;
     Peer& p = *peers_[r];
     std::lock_guard<std::mutex> lock(p.mu);
-    int rc = EnsureConnected(p);
-    if (rc != kOk) return rc;
     WireReq req{kMagic, kOpBarrier, rank_, 0, 0, 0, tag};
-    if (FullSend(p.fd, &req, sizeof(req)) != 0) return kErrTransport;
-    WireResp resp;
-    if (FullRecv(p.fd, &resp, sizeof(resp)) != 0 || resp.status != kOk)
-      return kErrTransport;
+    bool sent = EnsureConnected(p) == kOk &&
+                FullSend(p.fd, &req, sizeof(req)) == 0;
+    if (!sent && DebugOn())
+      std::fprintf(stderr, "[dds r%d] barrier tag=%lld notify r%d failed\n",
+                   rank_, static_cast<long long>(tag), r);
+  }
+  long timeout_s = 300;
+  if (const char* env = ::getenv("DDSTORE_BARRIER_TIMEOUT_S")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) timeout_s = v;
   }
   std::unique_lock<std::mutex> lock(barrier_mu_);
-  bool ok = barrier_cv_.wait_for(lock, std::chrono::seconds(300), [&] {
+  bool ok = barrier_cv_.wait_for(lock, std::chrono::seconds(timeout_s), [&] {
     auto it = barrier_arrived_.find(tag);
     return it != barrier_arrived_.end() && it->second >= world_ - 1;
   });
-  if (!ok) return kErrTransport;
+  if (!ok) {
+    auto it = barrier_arrived_.find(tag);
+    std::fprintf(stderr, "[dds r%d] barrier tag=%lld timed out after %lds "
+                 "(%lld/%d peers arrived)\n", rank_,
+                 static_cast<long long>(tag), timeout_s,
+                 static_cast<long long>(
+                     it == barrier_arrived_.end() ? 0 : it->second),
+                 world_ - 1);
+    // Erase on timeout too: tags are never reused (callers increment), so
+    // a stale partial count is pure leak + misleading later debug output.
+    if (it != barrier_arrived_.end()) barrier_arrived_.erase(it);
+    return kErrTransport;
+  }
   barrier_arrived_.erase(tag);
   return kOk;
 }

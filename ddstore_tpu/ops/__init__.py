@@ -1,0 +1,1 @@
+"""TPU kernels and compute ops (Pallas + XLA fallbacks)."""

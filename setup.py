@@ -1,0 +1,67 @@
+"""pip build for ddstore_tpu.
+
+Parity with the reference's pip path (/root/reference/setup.py:34-41, which
+cythonizes the binding + C++ core into one extension and requires
+``CC=mpicc CXX=mpicxx``): here the native C++17 core is compiled into a
+plain shared library bundled inside the wheel — no MPI toolchain, no
+Cython, no pkg-config. The ctypes binding (ddstore_tpu/binding.py) loads
+the bundled library, falling back to an on-demand g++ build from a source
+checkout (ddstore_tpu/_build.py).
+
+    pip install .          # builds ddstore_tpu/_lib/libddstore_tpu.so
+    python -m build        # wheel with the native lib inside
+"""
+
+import os
+import subprocess
+
+from setuptools import Command, setup
+from setuptools.command.build import build as _build
+from setuptools.command.build_py import build_py as _build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NATIVE = os.path.join(HERE, "ddstore_tpu", "native")
+SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc", "capi.cc"]
+
+
+def compile_native(out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "libddstore_tpu.so")
+    cxx = os.environ.get("DDSTORE_CXX", os.environ.get("CXX", "g++"))
+    cmd = [cxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread", "-Wall"]
+    cmd += [os.path.join(NATIVE, s) for s in SOURCES]
+    cmd += ["-o", out]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+class build_native(Command):
+    """Compile the C++ store core into the build tree."""
+
+    description = "compile the native ddstore_tpu core"
+    user_options = []
+
+    def initialize_options(self):
+        self.build_lib = None
+
+    def finalize_options(self):
+        self.set_undefined_options("build_py", ("build_lib", "build_lib"))
+
+    def run(self):
+        compile_native(os.path.join(self.build_lib, "ddstore_tpu", "_lib"))
+
+
+class build_py(_build_py):
+    def run(self):
+        super().run()
+        self.run_command("build_native")
+
+
+class build(_build):
+    sub_commands = _build.sub_commands + [("build_native", None)]
+
+
+setup(
+    cmdclass={"build_native": build_native, "build_py": build_py,
+              "build": build},
+)

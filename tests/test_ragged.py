@@ -1,0 +1,150 @@
+"""Ragged variable support: store-level add/get and pack/pad utilities.
+
+The reference enforces fixed-width rows (uniform disp via MPI_Allreduce
+MAX, ddstore.hpp:78-82); ragged samples are this framework's extension for
+its actual target workload (graphs). Tests use the rank-stamp oracle of
+the reference suite (test/demo.py:37,54-56): sample values encode the
+owning rank so any mis-routed read is caught.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStore, ThreadGroup
+from ddstore_tpu.data import (pack_ragged, pad_ragged,
+                              segment_ids_from_lengths, split_ragged)
+
+
+def _mk_samples(rank, n, dim, seed=0):
+    rng = np.random.default_rng(seed + rank)
+    lens = rng.integers(0, 7, size=n)
+    return [np.full((int(l), dim), rank + 1, np.float32) for l in lens]
+
+
+def _run_threads(world, body):
+    errs = []
+
+    def wrap(r):
+        try:
+            body(r)
+        except Exception as e:  # pragma: no cover
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=wrap, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_ragged_single_rank():
+    with DDStore(backend="local") as s:
+        samples = [np.arange(6, dtype=np.float32).reshape(3, 2),
+                   np.zeros((0, 2), np.float32),
+                   np.ones((5, 2), np.float32) * 7]
+        s.add_ragged("g", samples)
+        assert s.is_ragged("g")
+        assert not s.is_ragged("nope")
+        assert s.ragged_total("g") == 3
+        for i, want in enumerate(samples):
+            np.testing.assert_array_equal(s.get_ragged("g", i), want)
+        vals, lens = s.get_ragged_batch("g", [2, 0, 1])
+        assert lens.tolist() == [5, 3, 0]
+        np.testing.assert_array_equal(
+            vals, np.concatenate([samples[2], samples[0]], axis=0))
+
+
+def test_ragged_multirank_rank_stamp(tmp_path):
+    world, n, dim = 4, 12, 3
+    name = f"rag-{tmp_path.name}"
+
+    def body(rank):
+        g = ThreadGroup(name, rank, world)
+        with DDStore(g, backend="local") as s:
+            samples = _mk_samples(rank, n, dim)
+            s.add_ragged("g", samples)
+            assert s.ragged_total("g") == world * n
+            rng = np.random.default_rng(100 + rank)
+            idx = rng.integers(0, world * n, size=32)
+            vals, lens = s.get_ragged_batch("g", idx)
+            pos = 0
+            for i, l in zip(idx, lens):
+                owner = int(i) // n
+                got = vals[pos:pos + int(l)]
+                assert (got == owner + 1).all(), (i, owner, got)
+                pos += int(l)
+            # single-sample path agrees
+            one = s.get_ragged("g", int(idx[0]))
+            assert one.shape[0] == int(lens[0])
+            s.barrier()
+
+    _run_threads(world, body)
+
+
+def test_ragged_empty_rank(tmp_path):
+    """One rank holds zero samples; it still participates and reads."""
+    world = 2
+    name = f"rage-{tmp_path.name}"
+
+    def body(rank):
+        g = ThreadGroup(name, rank, world)
+        with DDStore(g, backend="local") as s:
+            samples = ([np.full((4, 2), 1.0, np.float32)] if rank == 0
+                       else [])
+            s.add_ragged("g", samples)
+            assert s.ragged_total("g") == 1
+            got = s.get_ragged("g", 0)
+            assert got.shape == (4, 2) and (got == 1.0).all()
+            s.barrier()
+
+    _run_threads(world, body)
+
+
+def test_pad_ragged():
+    values = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lengths = np.array([2, 0, 3])
+    dense, mask = pad_ragged(values, lengths, max_len=4)
+    assert dense.shape == (3, 4, 2) and mask.shape == (3, 4)
+    assert mask.sum() == 5
+    np.testing.assert_array_equal(dense[0, :2], values[:2])
+    np.testing.assert_array_equal(dense[2, :3], values[2:5])
+    assert (dense[1] == 0).all()
+    # truncation policy
+    dense2, mask2 = pad_ragged(values, lengths, max_len=2)
+    assert mask2[2].sum() == 2
+    np.testing.assert_array_equal(dense2[2], values[2:4])
+
+
+def test_split_roundtrip():
+    values = np.arange(12).reshape(6, 2)
+    lengths = [1, 3, 0, 2]
+    parts = split_ragged(values, lengths)
+    assert [len(p) for p in parts] == lengths
+    np.testing.assert_array_equal(np.concatenate(parts), values)
+
+
+def test_segment_ids():
+    ids = segment_ids_from_lengths(np.array([2, 1]), total=5)
+    assert ids.tolist() == [0, 0, 1, 2, 2]
+    with pytest.raises(ValueError):
+        segment_ids_from_lengths(np.array([4]), total=3)
+
+
+def test_pack_ragged():
+    values = np.arange(8, dtype=np.float32)[:, None]
+    lengths = np.array([3, 2, 3])
+    flat, seg, n_fit = pack_ragged(values, lengths, budget=6)
+    assert n_fit == 2
+    assert flat.shape == (6, 1)
+    np.testing.assert_array_equal(flat[:5, 0], values[:5, 0])
+    assert (flat[5:] == 0).all()
+    assert seg.tolist() == [0, 0, 0, 1, 1, 2]  # pad segment == n_fit
+    # everything fits
+    flat2, seg2, n2 = pack_ragged(values, lengths, budget=8)
+    assert n2 == 3 and seg2.tolist() == [0, 0, 0, 1, 1, 2, 2, 2]
+    # oversize head sample: error, not a silent all-padding batch
+    with pytest.raises(ValueError):
+        pack_ragged(values, lengths, budget=2)
