@@ -58,7 +58,8 @@ class DeviceLoader:
     def __init__(self, dataset, sampler: Iterable[int], batch_size: int,
                  mesh: Optional["Mesh"] = None, axis: str = "dp",
                  prefetch: int = 2, drop_last: bool = True,
-                 transform: Optional[Callable] = None):
+                 transform: Optional[Callable] = None,
+                 spec: Optional["PartitionSpec"] = None):
         self.dataset = dataset
         self.sampler = sampler
         self.batch_size = int(batch_size)
@@ -70,7 +71,12 @@ class DeviceLoader:
         self.metrics = PipelineMetrics()
         if mesh is not None and jax is None:  # pragma: no cover
             raise RuntimeError("jax unavailable but mesh given")
-        self._sharding = (NamedSharding(mesh, PartitionSpec(axis))
+        # `spec` overrides the default leading-dim-over-`axis` layout, e.g.
+        # P("dp", "sp") to stage sequence-sharded token windows directly in
+        # the layout the train step's in_shardings demand.
+        if spec is None:
+            spec = PartitionSpec(axis)
+        self._sharding = (NamedSharding(mesh, spec)
                          if mesh is not None else None)
 
     # -- internals ---------------------------------------------------------

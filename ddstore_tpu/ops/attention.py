@@ -1,0 +1,371 @@
+"""Attention ops: Pallas TPU flash attention + XLA reference.
+
+The building block for long-context support (sequence/context parallelism
+is absent in the reference — SURVEY §2.2 — and a first-class goal here).
+Both implementations return ``(out, lse)`` where ``lse`` is the per-query
+log-sum-exp of the attention scores: that pair is the composable unit —
+:func:`ddstore_tpu.parallel.ring_attention.ring_attention` combines
+``(out, lse)`` blocks across devices with the same online-softmax algebra
+the kernel uses across key blocks.
+
+Design notes (TPU):
+* the kernel streams K/V blocks through VMEM with a running (m, l, acc)
+  online softmax in f32 scratch — O(S) memory, no S×S materialization;
+* QK^T and PV ride the MXU via ``jnp.dot`` with f32 accumulation;
+* causal masking takes global ``q_offset``/``kv_offset`` so the same
+  kernel serves ring-attention steps, where the kv chunk's global
+  position rotates per step;
+* on CPU (tests) the identical kernel runs in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU/interpret-only; keep the module importable anywhere
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = float("-inf")
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                  causal: bool = False, q_offset: int = 0,
+                  kv_offset: int = 0, scale: Optional[float] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Plain-XLA attention over (..., S, D); returns (out, lse in f32)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[-2])[:, None]
+        kpos = kv_offset + jnp.arange(k.shape[-2])[None, :]
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # Fully-masked rows (possible in ring steps) must yield out=0, lse=-inf
+    # without NaNs: exp(-inf - -inf) is guarded by zeroing those rows.
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - safe_m)
+    p = jnp.where(jnp.isfinite(m), p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("...qk,...kd->...qd", p, v,
+                     preferred_element_type=jnp.float32)
+    out = out / jnp.maximum(l, 1e-30)
+    lse = (safe_m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    lse = jnp.where(jnp.isfinite(m[..., 0]), lse, NEG_INF)
+    return out.astype(q.dtype), lse
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, scale, causal, q_offset, kv_offset, block_q, block_k):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # For causal, a K/V block entirely in the future contributes nothing —
+    # predicate the whole accumulation away (≈halves causal FLOPs).
+    if causal:
+        live = (kv_offset + ik * block_k
+                <= q_offset + iq * block_q + block_q - 1)
+    else:
+        live = True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]  # (block_q, D)
+        k = k_ref[0]  # (block_k, D)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_offset + ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                               # (block_q, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Rows with everything masked so far keep m=-inf; guard the exps.
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(jnp.isfinite(m_new), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        m = m_scr[:, :1]
+        lse = jnp.where(jnp.isfinite(m),
+                        m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, 128))
+
+
+def _fwd_impl(q, k, v, causal, q_offset, kv_offset, scale, block_q, block_k,
+              interpret):
+    """Runs the forward kernel; returns (out, lse, lse128-residual)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    grid = (b * h, sq // block_q, sk // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, q_offset=q_offset,
+        kv_offset=kv_offset, block_q=block_q, block_k=block_k)
+    out_f, lse_f = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            # lse carries a broadcast 128-lane dim purely so its block is
+            # (block_q, 128)-tile-aligned for the TPU lowering; lane 0 is
+            # the value. The full tensor doubles as the backward residual.
+            pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),    # running numerator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return (out_f.reshape(b, h, sq, d), lse_f[..., 0].reshape(b, h, sq),
+            lse_f)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dq_ref,
+                   dq_acc, *, scale, causal, q_offset, kv_offset, block_q,
+                   block_k):
+    """dq for one q block, streaming k/v blocks (recompute-p flash bwd)."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = (kv_offset + ik * block_k
+                <= q_offset + iq * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_offset + ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        lse = lse_ref[0][:, :1]                              # (block_q, 1)
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        do = do_ref[0]
+        dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
+        # dta carries delta (= rowsum(do*o)) in lane 0 and the lse
+        # cotangent in lane 1: ds = p * (dp - delta + dlse).
+        t = p * (dp - dta_ref[0][:, :1] + dta_ref[0][:, 1:2])
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            t.astype(k.dtype), k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, scale, causal, q_offset,
+                    kv_offset, block_q, block_k):
+    """dk/dv for one k/v block, streaming q blocks."""
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        live = (kv_offset + ik * block_k
+                <= q_offset + iq * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kv_offset + ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        do = do_ref[0]
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
+        t = p * (dp - dta_ref[0][:, :1] + dta_ref[0][:, 1:2])
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            t.astype(q.dtype).T, q, preferred_element_type=jnp.float32) \
+            * scale
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, q_offset, kv_offset, scale, block_q, block_k,
+           interpret):
+    out, lse, _ = _fwd_impl(q, k, v, causal, q_offset, kv_offset, scale,
+                            block_q, block_k, interpret)
+    return out, lse
+
+
+def _flash_fwd(q, k, v, causal, q_offset, kv_offset, scale, block_q,
+               block_k, interpret):
+    out, lse, lse128 = _fwd_impl(q, k, v, causal, q_offset, kv_offset,
+                                 scale, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse128)
+
+
+def _flash_bwd(causal, q_offset, kv_offset, scale, block_q, block_k,
+               interpret, res, g):
+    q, k, v, out, lse128 = res
+    do, dlse = g
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bhs = b * h
+    qf = q.reshape(bhs, sq, d)
+    kf = k.reshape(bhs, sk, d)
+    vf = v.reshape(bhs, sk, d)
+    dof = do.reshape(bhs, sq, d)
+    # delta_i = rowsum(do_i * o_i); packed with the lse cotangent into the
+    # two leading lanes of a 128-lane tensor (tile-aligned input).
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * out.reshape(bhs, sq, d).astype(jnp.float32), axis=-1)
+    dta = jnp.zeros((bhs, sq, 128), jnp.float32)
+    dta = dta.at[..., 0].set(delta)
+    dta = dta.at[..., 1].set(dlse.reshape(bhs, sq).astype(jnp.float32))
+
+    common = dict(scale=scale, causal=causal, q_offset=q_offset,
+                  kv_offset=kv_offset, block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bhs, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bhs, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse128, dta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bhs, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, j, i: (bh, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bhs, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhs, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse128, dta)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False, q_offset: int = 0,
+                    kv_offset: int = 0, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Pallas flash attention over (B, H, S, D); returns (out, lse).
+
+    Differentiable: the backward pass is the standard recompute-p flash
+    backward as two Pallas kernels (dq streaming K/V blocks; dk/dv
+    streaming Q blocks), so training never materializes S×S. Sequence
+    lengths must divide by the block sizes (callers pad; the data layer's
+    budgets already guarantee static shapes). On non-TPU backends the
+    same kernels run in interpreter mode.
+    """
+    if not _HAS_PALLAS:  # pragma: no cover
+        return mha_reference(q, k, v, causal=causal, q_offset=q_offset,
+                             kv_offset=kv_offset, scale=scale)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) must divide blocks "
+                         f"({block_q},{block_k})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, q_offset, kv_offset, scale, block_q,
+                  block_k, interpret)

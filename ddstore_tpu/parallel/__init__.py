@@ -8,6 +8,7 @@ value-add that connects the host-side store to device meshes.
 
 from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
                    make_mesh, replicate)
+from .ring_attention import ring_attention, ring_self_attention
 from .shuffle import all_to_all_rows, global_shuffle_epoch, permute_rows
 
 __all__ = [
@@ -19,4 +20,6 @@ __all__ = [
     "all_to_all_rows",
     "permute_rows",
     "global_shuffle_epoch",
+    "ring_attention",
+    "ring_self_attention",
 ]

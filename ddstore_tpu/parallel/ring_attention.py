@@ -1,0 +1,117 @@
+"""Ring attention: exact attention over a sequence-parallel mesh axis.
+
+Long-context support the reference lacks entirely (SURVEY §2.2 lists
+SP/CP/ring attention as absent). Q, K, V are sharded along the sequence
+dimension over the ``sp`` mesh axis; each device keeps its Q chunk
+resident and the K/V chunks rotate around the ring with
+``jax.lax.ppermute`` (XLA lowers this to ICI neighbor exchanges that
+overlap with the per-step attention compute). Per-step partial results
+combine with the same online-softmax algebra flash attention uses across
+key blocks — each step yields ``(out_i, lse_i)`` and the running pair is
+reweighted by ``exp(lse - m)`` — so the result is EXACT attention over the
+full sequence, with O(S/n) memory per device and n ring steps.
+
+The per-step block computation defaults to the XLA path
+(:func:`ddstore_tpu.ops.attention.mha_reference`, fused well by XLA); on
+TPU backends it can use the Pallas flash kernel once per self-chunk.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import mha_reference
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+
+def _combine(acc_out, acc_lse, out_i, lse_i):
+    """Merge two normalized attention partials (f32 math)."""
+    m = jnp.maximum(acc_lse, lse_i)
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(jnp.isfinite(acc_lse), jnp.exp(acc_lse - safe_m), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - safe_m), 0.0)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    out = (acc_out * w1[..., None] + out_i.astype(jnp.float32)
+           * w2[..., None]) / denom[..., None]
+    lse = jnp.where(jnp.isfinite(m), safe_m + jnp.log(denom), -jnp.inf)
+    return out, lse
+
+
+def _ring_body(q, k, v, *, axis: str, n: int, causal: bool):
+    """shard_map body: local chunks (B, H, S/n, D)."""
+    idx = jax.lax.axis_index(axis)
+    sq, sk = q.shape[2], k.shape[2]
+    q_off = idx * sq
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    acc_out = jnp.zeros(q.shape, jnp.float32)
+    acc_lse = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    for step in range(n):
+        # After `step` rotations this device holds the kv chunk originally
+        # owned by (idx - step) mod n.
+        src = (idx - step) % n
+        kv_off = src * sk
+
+        def attend(args):
+            qq, kk, vv = args
+            return mha_reference(qq, kk, vv, causal=causal,
+                                 q_offset=q_off, kv_offset=kv_off)
+
+        if causal:
+            # A kv chunk entirely in this q chunk's future is fully
+            # masked: skip its O(S²/n²) compute on devices where that
+            # holds (half of all (device, step) pairs — the ring-level
+            # twin of the flash kernel's per-block `live` predicate).
+            out_i, lse_i = jax.lax.cond(
+                src <= idx, attend,
+                lambda args: (jnp.zeros(q.shape, q.dtype),
+                              jnp.full(q.shape[:3], -jnp.inf, jnp.float32)),
+                (q, k, v))
+        else:
+            out_i, lse_i = attend((q, k, v))
+        acc_out, acc_lse = _combine(acc_out, acc_lse, out_i, lse_i)
+        if step < n - 1:
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+    return acc_out.astype(q.dtype), acc_lse
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   mesh: Mesh, axis: str = "sp", causal: bool = False,
+                   batch_axis: Optional[str] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Exact attention over (B, H, S, D) with S sharded over ``axis``.
+
+    Returns ``(out, lse)`` like the ops-level kernels. ``batch_axis``
+    optionally shards B over a data-parallel mesh axis (defaults to "dp"
+    when the mesh has one). Callable inside jit: shard_map composes.
+    """
+    n = mesh.shape[axis]
+    if batch_axis is None and "dp" in mesh.shape:
+        batch_axis = "dp"
+    bspec = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
+        else None
+    spec = P(bspec, None, axis, None)
+    if n == 1:
+        return mha_reference(q, k, v, causal=causal)
+    body = functools.partial(_ring_body, axis=axis, n=n, causal=causal)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, P(bspec, None, axis)),
+        check_vma=False,
+    )(q, k, v)
+
+
+def ring_self_attention(x_heads, *, mesh: Mesh, axis: str = "sp",
+                        causal: bool = True) -> jax.Array:
+    """Convenience: q = k = v = x_heads (B, H, S, D); returns out only."""
+    out, _ = ring_attention(x_heads, x_heads, x_heads, mesh=mesh, axis=axis,
+                            causal=causal)
+    return out

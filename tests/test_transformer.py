@@ -1,0 +1,87 @@
+"""Long-context transformer: sequence-parallel train step equivalence vs
+the unsharded step (exactness oracle — ring attention is exact), plus
+store-fed training where token windows are fetched from the distributed
+store."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddstore_tpu import DDStore, SingleGroup
+from ddstore_tpu.data import DeviceLoader, DistributedSampler, ShardedDataset
+from ddstore_tpu.models import transformer
+from ddstore_tpu.parallel import make_mesh
+
+
+def _data(key, b, s, vocab):
+    tokens = jax.random.randint(jax.random.key(key), (b, s), 0, vocab,
+                                jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    positions = jnp.tile(jnp.arange(s, dtype=jnp.int32), (b, 1))
+    return tokens, targets, positions
+
+
+def test_forward_shapes():
+    model = transformer.TransformerLM(vocab=64, dim=32, heads=4, layers=2)
+    tok, _, pos = _data(0, 2, 64, 64)
+    params = model.init(jax.random.key(0), tok, pos)
+    logits = model.apply(params, tok, pos)
+    assert logits.shape == (2, 64, 64)
+
+
+def test_sp_step_matches_single_device():
+    # f32 compute so the only difference is the ring decomposition.
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    kw = dict(vocab=64, dim=32, heads=4, layers=2,
+              compute_dtype=jnp.float32)
+    model_sp = transformer.TransformerLM(mesh=mesh, **kw)
+    model_s = transformer.TransformerLM(**kw)
+    state_sp, tx = transformer.create_train_state(jax.random.key(0),
+                                                  model_sp, mesh=mesh)
+    state_s, tx_s = transformer.create_train_state(jax.random.key(0),
+                                                   model_s)
+    step_sp = transformer.make_train_step(model_sp, tx, mesh=mesh,
+                                          donate=False)
+    step_s = transformer.make_train_step(model_s, tx_s, donate=False)
+
+    tok, tgt, pos = _data(1, 4, 128, 64)
+    new_sp, loss_sp = step_sp(state_sp, tok, tgt, pos)
+    new_s, loss_s = step_s(state_s, tok, tgt, pos)
+    np.testing.assert_allclose(float(loss_sp), float(loss_s), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(new_sp.params),
+                    jax.tree.leaves(new_s.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_store_fed_lm_training_loss_decreases():
+    """Token windows live in the store; the model learns a repeated-pattern
+    corpus (loss must fall well below uniform log(vocab))."""
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    vocab, seq = 32, 128
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, vocab, size=16)
+    corpus = np.tile(base, 64 * seq // 16 + 2)
+    starts = rng.integers(0, len(corpus) - seq - 1, size=256)
+    windows = np.stack([corpus[s:s + seq] for s in starts]).astype(np.int32)
+    nexts = np.stack([corpus[s + 1:s + seq + 1] for s in starts]
+                     ).astype(np.int32)
+
+    with DDStore(SingleGroup(), backend="local") as store:
+        ds = ShardedDataset(store, windows, nexts)
+        model = transformer.TransformerLM(
+            vocab=vocab, dim=64, heads=4, layers=2, mesh=mesh)
+        state, tx = transformer.create_train_state(jax.random.key(0), model,
+                                                   lr=1e-3, mesh=mesh)
+        step = transformer.make_train_step(model, tx, mesh=mesh)
+        sampler = DistributedSampler(len(ds), 1, 0, seed=0)
+        pos = jnp.tile(jnp.arange(seq, dtype=jnp.int32), (8, 1))
+        losses = []
+        for epoch in range(2):
+            sampler.set_epoch(epoch)
+            loader = DeviceLoader(ds, sampler, batch_size=8, mesh=mesh,
+                                  spec=jax.P("dp", "sp"))
+            for tok, tgt in loader:
+                state, loss = step(state, tok, tgt, pos)
+                losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+        assert losses[-1] < np.log(vocab)
