@@ -5,6 +5,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -80,6 +81,15 @@ void SetNoDelay(int fd) {
 bool DebugOn() {
   static const bool on = ::getenv("DDSTORE_DEBUG") != nullptr;
   return on;
+}
+
+long EnvSeconds(const char* name, long dflt) {
+  if (const char* env = ::getenv(name)) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) return v;
+  }
+  return dflt;
 }
 
 }  // namespace
@@ -237,13 +247,21 @@ int TcpTransport::EnsureConnected(Peer& p) {
     return kErrTransport;
 
   int fd = -1;
+  // Peers start asynchronously; retry connect within a bounded budget
+  // (failure detection: a peer that never comes up surfaces as
+  // kErrTransport, not an indefinite spin — the reference's only retry is
+  // fi_read on -EAGAIN, common.cxx:332-343, with no bound at all).
+  const auto budget = std::chrono::seconds(
+      EnvSeconds("DDSTORE_CONNECT_TIMEOUT_S", 30));
+  // Wall-clock budget (not sleep-count): a blackholed peer makes each
+  // ::connect itself block for the kernel SYN timeout, which must count.
+  const auto deadline = std::chrono::steady_clock::now() + budget;
   for (addrinfo* ai = res; ai; ai = ai->ai_next) {
     fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
-    // Peers start asynchronously; retry connect briefly.
-    int attempts = 0;
     while (::connect(fd, ai->ai_addr, ai->ai_addrlen) < 0) {
-      if ((errno == ECONNREFUSED || errno == ETIMEDOUT) && attempts++ < 600 &&
+      if ((errno == ECONNREFUSED || errno == ETIMEDOUT) &&
+          std::chrono::steady_clock::now() < deadline &&
           !stopping_.load()) {
         std::this_thread::sleep_for(std::chrono::milliseconds(50));
         continue;
@@ -257,6 +275,14 @@ int TcpTransport::EnsureConnected(Peer& p) {
   ::freeaddrinfo(res);
   if (fd < 0) return kErrTransport;
   SetNoDelay(fd);
+  // A peer that is alive but wedged (or died without RST) must not hang
+  // readers forever: bound every response wait. FullRecv treats the
+  // EAGAIN timeout as failure, ReadV resets the connection and surfaces
+  // kErrTransport to the caller.
+  timeval tv;
+  tv.tv_sec = EnvSeconds("DDSTORE_READ_TIMEOUT_S", 300);
+  tv.tv_usec = 0;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   p.fd = fd;
   return kOk;
 }

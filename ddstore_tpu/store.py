@@ -37,11 +37,13 @@ def _my_host() -> str:
 
 
 class _VarMeta:
-    __slots__ = ("dtype", "sample_shape", "disp", "all_nrows", "pinned")
+    __slots__ = ("dtype", "sample_shape", "disp", "all_nrows", "pinned",
+                 "readonly")
 
     def __init__(self, dtype: np.dtype, sample_shape: Tuple[int, ...],
                  disp: int, all_nrows: Sequence[int],
-                 pinned: Optional[np.ndarray] = None):
+                 pinned: Optional[np.ndarray] = None,
+                 readonly: bool = False):
         self.dtype = dtype
         self.sample_shape = sample_shape
         self.disp = disp
@@ -49,6 +51,9 @@ class _VarMeta:
         # With copy=False the native core borrows this buffer; holding it
         # here keeps it alive for the lifetime of the variable.
         self.pinned = pinned
+        # True for read-only mmap backings: `update` must refuse rather
+        # than memcpy into unwritable pages (SIGSEGV).
+        self.readonly = readonly
 
 
 class DDStore:
@@ -117,11 +122,15 @@ class DDStore:
 
     # -- registration ------------------------------------------------------
 
-    def add(self, name: str, arr: np.ndarray) -> None:
+    def add(self, name: str, arr: np.ndarray,
+            copy: Optional[bool] = None, readonly: bool = False) -> None:
         """Register this rank's shard. ``arr`` is sample-major: shape
         ``(nrows, *sample_shape)``; one global row == one sample (fixing the
         reference adapter's flattened-blob indexing trap,
-        distdataset.py:63,84 where ``disp=1`` made row != sample)."""
+        distdataset.py:63,84 where ``disp=1`` made row != sample).
+        ``copy`` overrides the store default (False borrows the buffer —
+        how mmap-backed tiering serves from page cache)."""
+        copy = self.copy if copy is None else copy
         arr = np.ascontiguousarray(arr)
         if arr.ndim == 0:
             raise ValueError("shard must have a leading sample dimension")
@@ -135,9 +144,10 @@ class DDStore:
             raise DDStoreError(-9, f"add({name}): ranks disagree on "
                                    f"dtype/sample shape: {sorted(shapes)}")
         all_nrows = [m[0] for m in metas]
-        self._native.add(name, arr, all_nrows, copy=self.copy)
+        self._native.add(name, arr, all_nrows, copy=copy)
         self._meta[name] = _VarMeta(arr.dtype, sample_shape, disp, all_nrows,
-                                    pinned=None if self.copy else arr)
+                                    pinned=None if copy else arr,
+                                    readonly=readonly)
         # `add` is collective in the reference (MPI_Win_create,
         # ddstore.hpp:56-62); completing it with a barrier gives the same
         # guarantee: once any rank returns, every shard is readable.
@@ -164,6 +174,9 @@ class DDStore:
         """Overwrite local rows [row_offset, row_offset+len(arr)) (reference
         ``update``, pyddstore.pyx:115-131 — bounds-checked here)."""
         m = self._require(name)
+        if m.readonly:
+            raise DDStoreError(-1, f"update({name}): variable is backed by "
+                                   "a read-only mapping")
         arr = np.ascontiguousarray(arr, dtype=m.dtype)
         if tuple(arr.shape[1:]) != m.sample_shape:
             raise ValueError(
@@ -208,6 +221,52 @@ class DDStore:
                 f"get({name}): out must be {want} {m.dtype}, got "
                 f"{tuple(out.shape)} {out.dtype}")
         return out
+
+    # -- disk / NVMe tiering ----------------------------------------------
+    #
+    # Shards larger than host RAM: register an mmap-backed buffer with
+    # copy=False — the store serves one-sided reads straight out of the OS
+    # page cache, so the kernel tiers hot rows in RAM and cold rows on
+    # NVMe. The reference holds everything in MPI_Alloc_mem'd RAM and
+    # doubles it at registration (ddstore.hpp:43-49); this is the
+    # capability BASELINE.md's billion-edge / host↔NVMe config asks for.
+
+    def add_mmap(self, name: str, path: str, dtype,
+                 sample_shape: Tuple[int, ...], mode: str = "r") -> None:
+        """Register a file-backed shard (collective). ``nrows`` is inferred
+        from the file size; ``mode="r+"`` keeps ``update`` usable."""
+        dtype = np.dtype(dtype)
+        disp = int(np.prod(sample_shape, dtype=np.int64)) if sample_shape \
+            else 1
+        row_bytes = disp * dtype.itemsize
+        size = os.path.getsize(path)
+        if size % row_bytes:
+            raise ValueError(f"add_mmap({name}): {path} size {size} is not "
+                             f"a multiple of row bytes {row_bytes}")
+        nrows = size // row_bytes
+        if nrows:
+            arr = np.memmap(path, dtype=dtype, mode=mode,
+                            shape=(nrows,) + tuple(sample_shape))
+        else:  # a rank may own zero rows; mmap of an empty file is invalid
+            arr = np.empty((0,) + tuple(sample_shape), dtype)
+        self.add(name, arr, copy=False, readonly=(mode == "r"))
+
+    def spill_to_disk(self, name: str, directory: str,
+                      chunk_rows: int = 65536) -> str:
+        """Move this variable's local shard from RAM to a file-backed
+        mapping (collective: every rank spills its own shard). Remote
+        readers are unaffected — reads are served from page cache. The
+        on-disk artifact is a checkpoint shard (``utils.save_shard``
+        format, JSON sidecar included), so a spilled variable restores
+        across restarts with ``utils.load_shard(..., mmap=True)``."""
+        from .utils.checkpoint import save_shard
+
+        m = self._require(name)
+        dtype, sample_shape = m.dtype, m.sample_shape
+        path = save_shard(self, name, directory, chunk_rows=chunk_rows)
+        self.free(name)
+        self.add_mmap(name, path, dtype, sample_shape)
+        return path
 
     # -- ragged variables --------------------------------------------------
     #

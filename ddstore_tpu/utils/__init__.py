@@ -1,5 +1,8 @@
-"""Metrics, logging, and small helpers."""
+"""Metrics, checkpointing, and small helpers."""
 
+from .checkpoint import (load_shard, restore_train_state, save_shard,
+                         save_train_state)
 from .metrics import LatencyHistogram, PipelineMetrics
 
-__all__ = ["LatencyHistogram", "PipelineMetrics"]
+__all__ = ["LatencyHistogram", "PipelineMetrics", "save_train_state",
+           "restore_train_state", "save_shard", "load_shard"]

@@ -1,0 +1,90 @@
+"""Checkpoint / resume: train state via orbax, store shards via raw files.
+
+The reference has no checkpointing at all — not for the store (data is
+reloaded from source each run, SURVEY §5) and not for its example model.
+Here both halves are covered:
+
+* :func:`save_train_state` / :func:`restore_train_state` — any pytree of
+  arrays (the models' ``TrainState`` NamedTuples) through orbax's
+  StandardCheckpointer (async-safe, multihost-aware).
+* :func:`save_shard` / :func:`load_shard` — a store variable's LOCAL
+  shard to/from a per-rank binary file plus a JSON sidecar; restore is a
+  collective ``add`` (or an mmap-backed ``add_mmap`` to come back in
+  tiered mode). This turns ``init``+``update`` incremental population
+  (reference ddstore.hpp:110-195) into durable resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["save_train_state", "restore_train_state", "save_shard",
+           "load_shard"]
+
+
+def _ckpt_path(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def save_train_state(path: str, state: Any) -> None:
+    """Write a pytree of arrays (blocking)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(_ckpt_path(path), state, force=True)
+
+
+def restore_train_state(path: str, like: Any) -> Any:
+    """Read a pytree checkpoint; ``like`` supplies structure/shardings
+    (pass the freshly-created state — restored arrays adopt its
+    shardings, so resume works on any mesh of the same shape)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(_ckpt_path(path), target=like)
+
+
+def save_shard(store, name: str, directory: str,
+               chunk_rows: int = 65536) -> str:
+    """Write this rank's shard of ``name`` to ``<dir>/<name>.r<rank>.bin``
+    with a JSON sidecar. Local-only IO; call on every rank."""
+    m = store._require(name)
+    begin, end = store.my_row_range(name)
+    os.makedirs(directory, exist_ok=True)
+    stem = os.path.join(directory,
+                        f"{name.replace('/', '_')}.r{store.rank}")
+    with open(stem + ".bin", "wb") as f:
+        for s in range(begin, end, chunk_rows):
+            store.get(name, s, min(chunk_rows, end - s)).tofile(f)
+    with open(stem + ".json", "w") as f:
+        json.dump({"dtype": m.dtype.str, "sample_shape": list(m.sample_shape),
+                   "nrows": end - begin, "rank": store.rank,
+                   "world": store.world}, f)
+    return stem + ".bin"
+
+
+def load_shard(store, name: str, directory: str, *,
+               mmap: bool = False, rank: Optional[int] = None) -> None:
+    """Collective: re-register ``name`` from files written by
+    :func:`save_shard`. ``mmap=True`` restores in tiered (file-backed,
+    read-only) mode; otherwise the shard is copied back into RAM.
+    ``rank`` overrides which rank's file this process loads (for
+    re-sharding onto a differently-ranked relaunch)."""
+    r = store.rank if rank is None else rank
+    stem = os.path.join(directory, f"{name.replace('/', '_')}.r{r}")
+    with open(stem + ".json") as f:
+        meta = json.load(f)
+    dtype = np.dtype(meta["dtype"])
+    sample_shape = tuple(meta["sample_shape"])
+    if mmap:
+        store.add_mmap(name, stem + ".bin", dtype, sample_shape)
+    else:
+        nrows = meta["nrows"]
+        arr = (np.fromfile(stem + ".bin", dtype=dtype)
+               .reshape((nrows,) + sample_shape)) if nrows else \
+            np.empty((0,) + sample_shape, dtype)
+        store.add(name, arr)

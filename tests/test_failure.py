@@ -1,0 +1,76 @@
+"""Failure detection: dead or absent peers surface as DDStoreError within
+bounded time — never an indefinite hang. (The reference has no failure
+handling beyond exit(1)/throw, SURVEY §5; its fi_read retries -EAGAIN
+unboundedly, common.cxx:332-343.)"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStoreError, NativeStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_connect_to_absent_peer_times_out(monkeypatch):
+    monkeypatch.setenv("DDSTORE_CONNECT_TIMEOUT_S", "1")
+    ns = NativeStore.create_tcp(0, 2, 0)
+    try:
+        # peer 1 does not exist: a port nothing listens on
+        ns.set_peers(["127.0.0.1", "127.0.0.1"], [ns.server_port, 1])
+        ns.add("v", np.ones((4, 2)), [4, 4], copy=True)
+        out = np.empty((1, 2))
+        t0 = time.perf_counter()
+        with pytest.raises(DDStoreError):
+            ns.get("v", out, 5, 1)  # row 5 lives on absent rank 1
+        assert time.perf_counter() - t0 < 20
+    finally:
+        ns.close()
+
+
+_PEER_SCRIPT = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from ddstore_tpu import DDStore, FileGroup
+
+rank = int(os.environ["DDSTORE_RANK"])
+g = FileGroup(os.environ["DDSTORE_RDV_DIR"], rank, 2)
+store = DDStore(g, backend="tcp")
+store.add("v", np.full((8, 2), rank + 1, np.float64))
+# both ranks confirm cross reads work
+got = store.get("v", (1 - rank) * 8)
+assert (got == 2 - rank).all()
+store.barrier()
+if rank == 0:
+    print("R0READY", flush=True)
+    os._exit(0)  # die abruptly: no close, no barrier
+# rank 1: wait for rank 0 to be gone, then a remote read must ERROR
+time.sleep(1.0)
+try:
+    for _ in range(50):
+        store.get("v", 0)
+        time.sleep(0.1)
+    print("R1NOERROR", flush=True)
+except Exception as e:
+    print("R1GOTERROR", type(e).__name__, flush=True)
+"""
+
+
+def test_peer_death_surfaces_error(tmp_path):
+    env = dict(os.environ, DDSTORE_RDV_DIR=str(tmp_path),
+               DDSTORE_READ_TIMEOUT_S="5", DDSTORE_CONNECT_TIMEOUT_S="2")
+    script = _PEER_SCRIPT.format(repo=REPO)
+    procs = []
+    for r in (0, 1):
+        e = dict(env, DDSTORE_RANK=str(r))
+        procs.append(subprocess.Popen([sys.executable, "-c", script],
+                                      env=e, stdout=subprocess.PIPE,
+                                      text=True))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert "R0READY" in outs[0]
+    assert "R1GOTERROR DDStoreError" in outs[1], outs
