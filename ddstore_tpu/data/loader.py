@@ -19,9 +19,9 @@ called out in §7 as the anti-pattern to fix). Here the loader:
 from __future__ import annotations
 
 import itertools
-import queue
-import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -49,7 +49,16 @@ class DeviceLoader:
     mesh / spec: optional device staging target. If given, batches are
         device arrays sharded over ``spec`` (default: leading dim over
         axis "dp"); if None, numpy batches are yielded (host-only mode).
-    prefetch: how many batches the background thread keeps in flight.
+    prefetch: how many batches are kept in flight ahead of the consumer.
+    workers: fetch+stage worker threads. One worker pipelines host IO
+        against device compute; more overlap multiple batches' host paths
+        with each other — needed to keep small/fast models fed (ctypes
+        releases the GIL during store reads, and staging is mostly
+        off-GIL transfer work, so threads genuinely parallelize).
+        CONTRACT: with workers > 1, ``dataset.fetch`` and ``transform``
+        are called concurrently and must be thread-safe (store reads and
+        the bundled datasets are; a stateful transform — e.g. one sharing
+        a np.random.Generator — is not: pass workers=1 for those).
     drop_last: drop the trailing partial batch (keeps shapes static for
         jit — recompile-free epochs).
     transform: optional host-side function applied to each fetched batch.
@@ -57,15 +66,17 @@ class DeviceLoader:
 
     def __init__(self, dataset, sampler: Iterable[int], batch_size: int,
                  mesh: Optional["Mesh"] = None, axis: str = "dp",
-                 prefetch: int = 2, drop_last: bool = True,
+                 prefetch: int = 4, drop_last: bool = True,
                  transform: Optional[Callable] = None,
-                 spec: Optional["PartitionSpec"] = None):
+                 spec: Optional["PartitionSpec"] = None,
+                 workers: int = 2):
         self.dataset = dataset
         self.sampler = sampler
         self.batch_size = int(batch_size)
         self.mesh = mesh
         self.axis = axis
         self.prefetch = max(1, int(prefetch))
+        self.workers = max(1, int(workers))
         self.drop_last = drop_last
         self.transform = transform
         self.metrics = PipelineMetrics()
@@ -107,56 +118,31 @@ class DeviceLoader:
             return jax.tree_util.tree_map(put, batch)
 
     def __iter__(self):
+        # Ordered worker pool: index batches are submitted in order and
+        # futures consumed in submission order, so parallel fetch+stage
+        # never reorders the epoch's batch stream. Early exit (break) is
+        # safe: shutdown waits for in-flight fetches, so a subsequent
+        # store teardown can't race them.
         self.metrics.epoch_start()
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = threading.Event()
-        SENTINEL = object()
-
-        def producer():
-            def put(item):
-                # A plain q.put can block forever if the consumer broke out
-                # (e.g. a step cap) after the final drain — check stop while
-                # waiting so the thread always exits and never races a
-                # store teardown with an in-flight fetch.
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        return True
-                    except queue.Full:
-                        continue
-                return False
-
-            try:
-                for idx in self._index_batches():
-                    if stop.is_set():
-                        return
-                    if not put(self._fetch(idx)):
-                        return
-                put(SENTINEL)
-            except BaseException as e:  # surface in consumer
-                put(e)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
+        ex = ThreadPoolExecutor(max_workers=self.workers,
+                                thread_name_prefix="ddstore-loader")
+        futs = deque()
         try:
-            while True:
+            it = self._index_batches()
+            for idx in itertools.islice(it, self.prefetch):
+                futs.append(ex.submit(self._fetch, idx))
+            while futs:
                 t0 = time.perf_counter()
-                item = q.get()
+                item = futs.popleft().result()
                 self.metrics.wait.record(time.perf_counter() - t0)
-                if item is SENTINEL:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
+                nxt = next(it, None)
+                if nxt is not None:
+                    futs.append(ex.submit(self._fetch, nxt))
                 yield item
         finally:
-            stop.set()
-            # Drain so the producer's blocked put() can finish.
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=10)
+            for f in futs:
+                f.cancel()
+            ex.shutdown(wait=True)
             self.metrics.epoch_end()
 
     def __len__(self) -> int:

@@ -78,4 +78,13 @@ int LocalTransport::Read(int target, const std::string& name, int64_t offset,
   return peer->ReadLocal(name, offset, nbytes, dst);
 }
 
+int LocalTransport::ReadV(int target, const std::string& name,
+                          const ReadOp* ops, int64_t n) {
+  // Peer resolution and the registry lookup happen once for the batch
+  // (the base-class default would pay both per op).
+  Store* peer = group_->member(target);
+  if (!peer) return kErrTransport;
+  return peer->ReadLocalV(name, ops, n);
+}
+
 }  // namespace dds

@@ -62,6 +62,8 @@ class LocalTransport : public Transport {
 
   int Read(int target, const std::string& name, int64_t offset,
            int64_t nbytes, void* dst) override;
+  int ReadV(int target, const std::string& name, const ReadOp* ops,
+            int64_t n) override;
   int Barrier(int64_t tag) override { return group_->Barrier(tag); }
   int rank() const override { return rank_; }
   int world() const override { return group_->world(); }

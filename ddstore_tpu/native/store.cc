@@ -166,18 +166,24 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
     runs.push_back(Run{target, off, rb, i * rb});
   }
 
-  // Partition runs by peer; serve local runs inline, issue one worker thread
-  // per distinct remote peer. Each peer's runs go through one pipelined
+  // Partition runs by peer; serve local runs in one vectored call (one
+  // lock + lookup for the whole batch), issue one worker thread per
+  // distinct remote peer. Each peer's runs go through one pipelined
   // ReadV (1 round trip amortized over all runs to that peer).
   std::map<int, std::vector<ReadOp>> by_peer;
+  std::vector<ReadOp> local_ops;
   char* out = static_cast<char*>(dst);
   for (const Run& r : runs) {
     if (r.target == rank()) {
-      int rc = ReadLocal(name, r.offset, r.nbytes, out + r.dst_off);
-      if (rc != kOk) return rc;
+      local_ops.push_back(ReadOp{r.offset, r.nbytes, out + r.dst_off});
     } else {
       by_peer[r.target].push_back(ReadOp{r.offset, r.nbytes, out + r.dst_off});
     }
+  }
+  if (!local_ops.empty()) {
+    int rc = ReadLocalV(name, local_ops.data(),
+                        static_cast<int64_t>(local_ops.size()));
+    if (rc != kOk) return rc;
   }
   if (by_peer.empty()) return kOk;
 
@@ -270,6 +276,22 @@ int Store::ReadLocal(const std::string& name, int64_t offset,
   if (offset < 0 || nbytes < 0 || offset + nbytes > v.shard_bytes())
     return kErrOutOfRange;
   std::memcpy(dst, v.base + offset, nbytes);
+  return kOk;
+}
+
+int Store::ReadLocalV(const std::string& name, const ReadOp* ops,
+                      int64_t n) const {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  const VarInfo& v = it->second;
+  const int64_t sb = v.shard_bytes();
+  for (int64_t i = 0; i < n; ++i) {
+    const ReadOp& op = ops[i];
+    if (op.offset < 0 || op.nbytes < 0 || op.offset + op.nbytes > sb)
+      return kErrOutOfRange;
+    std::memcpy(op.dst, v.base + op.offset, op.nbytes);
+  }
   return kOk;
 }
 

@@ -187,6 +187,12 @@ class Store {
   int ReadLocal(const std::string& name, int64_t offset, int64_t nbytes,
                 void* dst) const;
 
+  // Vectored ReadLocal: one lock acquisition + one registry lookup for n
+  // copies. The batched-read hot path serves hundreds of per-row local
+  // runs per call; per-run locking dominates otherwise.
+  int ReadLocalV(const std::string& name, const ReadOp* ops,
+                 int64_t n) const;
+
   // Validate a prospective ReadLocal without touching memory. Serving
   // threads call this BEFORE sizing their scratch buffer, so a corrupt or
   // hostile request length is answered with an error code instead of an

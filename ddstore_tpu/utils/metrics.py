@@ -5,30 +5,34 @@ input-pipeline-efficiency metric that is the BASELINE.json north star
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional
 
 
 class LatencyHistogram:
-    """Streaming latency recorder with percentile summaries."""
+    """Streaming latency recorder with percentile summaries. Thread-safe:
+    the loader's worker pool records fetch/stage latencies concurrently."""
 
     def __init__(self, name: str = "latency", max_samples: int = 1 << 16):
         self.name = name
         self.max_samples = max_samples
         self._samples: List[float] = []
+        self._mu = threading.Lock()
         self.count = 0
         self.total = 0.0
 
     def record(self, seconds: float) -> None:
-        self.count += 1
-        self.total += seconds
-        if len(self._samples) < self.max_samples:
-            self._samples.append(seconds)
-        else:  # reservoir sampling keeps percentiles honest on long runs
-            import random
-            j = random.randrange(self.count)
-            if j < self.max_samples:
-                self._samples[j] = seconds
+        with self._mu:
+            self.count += 1
+            self.total += seconds
+            if len(self._samples) < self.max_samples:
+                self._samples.append(seconds)
+            else:  # reservoir sampling keeps percentiles honest on long runs
+                import random
+                j = random.randrange(self.count)
+                if j < self.max_samples:
+                    self._samples[j] = seconds
 
     def timed(self):
         """Context manager: ``with hist.timed(): ...``"""
