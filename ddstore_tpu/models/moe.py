@@ -1,0 +1,74 @@
+"""Mixture-of-experts layer with expert parallelism over the ``ep`` axis.
+
+Switch-style top-1 routing with a fixed per-expert capacity: tokens are
+dispatched to expert buffers with one-hot einsums (static shapes — no
+gather/scatter with data-dependent sizes), the expert FFNs are batched
+einsums over a leading expert dimension, and sharding that dimension over
+``ep`` (``parallel.tp.expert_rules``) makes XLA insert the all-to-alls of
+classic expert parallelism. Load balancing uses the standard Switch aux
+loss (fraction-routed × mean-router-prob, scaled by E).
+
+(EP is absent in the reference — SURVEY §2.2; with this module the
+framework covers the full dp/tp/pp/sp/ep set.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MLP replacement: ``(T, d) -> ((T, d), aux_loss)``."""
+
+    n_experts: int
+    hidden: int
+    capacity_factor: float = 2.0
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        t, d = x.shape
+        e = self.n_experts
+        cap = max(1, int(self.capacity_factor * t / e))
+        dt = self.compute_dtype
+
+        # Router in f32 (tiny matmul; numerics matter more than speed).
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
+        expert = jnp.argmax(probs, axis=-1)                  # (T,)
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # (T, E)
+        # 1-indexed arrival position of each token within its expert;
+        # tokens past capacity are dropped (standard Switch overflow).
+        pos = jnp.cumsum(onehot, axis=0) * onehot
+        keep = (pos > 0) & (pos <= cap)
+        dm = keep[..., None] * jax.nn.one_hot(                  # (T, E, C)
+            (pos - 1).astype(jnp.int32), cap, dtype=jnp.float32)
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (e, d, self.hidden))
+        b1 = self.param("b1", nn.initializers.zeros, (e, self.hidden))
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (e, self.hidden, d))
+        b2 = self.param("b2", nn.initializers.zeros, (e, d))
+
+        xin = jnp.einsum("tec,td->ecd", dm, x.astype(jnp.float32))
+        h = jnp.einsum("ecd,edh->ech", xin.astype(dt), w1.astype(dt))
+        h = nn.relu(h + b1[:, None, :].astype(dt))
+        out = jnp.einsum("ech,ehd->ecd", h, w2.astype(dt))
+        out = out + b2[:, None, :].astype(dt)
+        combine = dm * gate[:, None, None]
+        y = jnp.einsum("tec,ecd->td", combine,
+                       out.astype(jnp.float32))
+
+        # Switch load-balancing loss: E * Σ_e f_e · p̄_e (==1 at uniform).
+        frac = onehot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        aux = e * jnp.sum(frac * mean_prob)
+        return y.astype(x.dtype), aux

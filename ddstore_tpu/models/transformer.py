@@ -26,6 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention, mha_reference
 from ..parallel.ring_attention import ring_attention
+from ..parallel.tp import (expert_rules, megatron_rules, shard_pytree,
+                           shardings_of)
 
 
 class Block(nn.Module):
@@ -35,6 +37,7 @@ class Block(nn.Module):
     compute_dtype: Any
     mesh: Optional[Mesh]
     sp_axis: str
+    n_experts: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -63,9 +66,17 @@ class Block(nn.Module):
                          name="proj")(out)
 
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dt)
-        h = nn.Dense(self.mlp_ratio * self.dim, dtype=dt, name="up")(h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(self.dim, dtype=dt, name="down")(h)
+        if self.n_experts > 0:
+            from .moe import MoeMlp
+            y, aux = MoeMlp(self.n_experts, self.mlp_ratio * self.dim,
+                            compute_dtype=dt, name="moe")(
+                h.reshape(b * s, self.dim))
+            self.sow("intermediates", "moe_aux", aux)
+            x = x + y.reshape(b, s, self.dim).astype(dt)
+        else:
+            h = nn.Dense(self.mlp_ratio * self.dim, dtype=dt, name="up")(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(self.dim, dtype=dt, name="down")(h)
         return x
 
 
@@ -78,6 +89,7 @@ class TransformerLM(nn.Module):
     compute_dtype: Any = jnp.bfloat16
     mesh: Optional[Mesh] = None   # enables ring attention when sp > 1
     sp_axis: str = "sp"
+    n_experts: int = 0            # > 0 swaps the MLP for a switch-MoE
 
     @nn.compact
     def __call__(self, tokens, positions):
@@ -96,7 +108,7 @@ class TransformerLM(nn.Module):
         for i in range(self.layers):
             x = Block(self.dim, self.heads, self.mlp_ratio,
                       self.compute_dtype, self.mesh, self.sp_axis,
-                      name=f"block{i}")(x)
+                      n_experts=self.n_experts, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
         return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                         name="head")(x)
@@ -126,19 +138,47 @@ def create_train_state(rng: jax.Array, model: TransformerLM,
     init_model = model.clone(mesh=None)
     params = init_model.init(rng, tok, jnp.tile(jnp.arange(8), (1, 1)))
     tx = optax.adam(lr)
-    state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
-    if mesh is not None:
-        state = jax.device_put(state, NamedSharding(mesh, P()))
-    return state, tx
+    if mesh is None:
+        return TrainState(params, tx.init(params),
+                          jnp.zeros((), jnp.int32)), tx
+    repl = NamedSharding(mesh, P())
+    tp = mesh.shape.get("tp", 1) > 1
+    ep = mesh.shape.get("ep", 1) > 1
+    if ep:
+        # Experts over ep (optionally composed with megatron TP).
+        params = shard_pytree(params, mesh,
+                              expert_rules("ep", "tp" if tp else None))
+    elif tp:
+        # Megatron-style TP: place params per the sharding rules; the
+        # optimizer state inherits placement via zeros_like.
+        params = shard_pytree(params, mesh, megatron_rules("tp"))
+    else:
+        params = jax.device_put(params, repl)
+    state = TrainState(params, tx.init(params),
+                       jnp.zeros((), jnp.int32))
+    # Stragglers (optimizer scalars like adam's count) still live on a
+    # single device; one jit must not mix meshes, so replicate them.
+    fix = lambda x: x if isinstance(getattr(x, "sharding", None),
+                                    NamedSharding) else \
+        jax.device_put(x, repl)
+    return jax.tree_util.tree_map(fix, state), tx
 
 
 def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,
-                    mesh: Optional[Mesh] = None, donate: bool = True):
-    """Jitted dp×sp train step: (tokens, targets, positions) all (B, S),
-    batch over ``dp``, sequence over ``sp``."""
+                    mesh: Optional[Mesh] = None, donate: bool = True,
+                    state: Optional[TrainState] = None):
+    """Jitted dp×sp(×tp) train step: (tokens, targets, positions) all
+    (B, S), batch over ``dp``, sequence over ``sp``. Pass ``state`` when
+    its params carry TP shardings — the step pins them in place (and the
+    gradient/optimizer math stays sharded the same way)."""
 
     def step(state: TrainState, tokens, targets, positions):
         def lossf(params):
+            if model.n_experts > 0:
+                logits, inter = model.apply(params, tokens, positions,
+                                            mutable=("intermediates",))
+                aux = sum(jax.tree_util.tree_leaves(inter)) / model.layers
+                return loss_fn(logits, targets) + 0.01 * aux
             logits = model.apply(params, tokens, positions)
             return loss_fn(logits, targets)
 
@@ -150,9 +190,16 @@ def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
     repl = NamedSharding(mesh, P())
+    if state is None and (mesh.shape.get("tp", 1) > 1
+                          or mesh.shape.get("ep", 1) > 1):
+        # Defaulting to replicated here would silently gather the whole
+        # model to every device and undo the TP/EP sharding.
+        raise ValueError("mesh has tp/ep axes: pass the sharded `state` "
+                         "so the step pins its param shardings")
+    state_sh = shardings_of(state) if state is not None else repl
     dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
     sp = model.sp_axis if mesh.shape.get(model.sp_axis, 1) > 1 else None
     seq = NamedSharding(mesh, P(dp, sp))
-    return jax.jit(step, in_shardings=(repl, seq, seq, seq),
-                   out_shardings=(repl, repl),
+    return jax.jit(step, in_shardings=(state_sh, seq, seq, seq),
+                   out_shardings=(state_sh, repl),
                    donate_argnums=(0,) if donate else ())

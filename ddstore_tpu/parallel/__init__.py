@@ -8,8 +8,10 @@ value-add that connects the host-side store to device meshes.
 
 from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
                    make_mesh, replicate)
+from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import ring_attention, ring_self_attention
 from .shuffle import all_to_all_rows, global_shuffle_epoch, permute_rows
+from .tp import expert_rules, megatron_rules, shard_pytree, shardings_of
 
 __all__ = [
     "make_mesh",
@@ -22,4 +24,10 @@ __all__ = [
     "global_shuffle_epoch",
     "ring_attention",
     "ring_self_attention",
+    "megatron_rules",
+    "expert_rules",
+    "shard_pytree",
+    "shardings_of",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe scheduling over the ``pp`` mesh axis.
+
+Layers are grouped into S stages whose parameters live stacked along a
+leading stage dimension sharded over ``pp`` (so each device holds one
+stage). Microbatches stream through the ring: at every schedule step each
+device applies its stage to the activation it holds and ``ppermute``s the
+result to the next stage, for M + S - 1 steps (the classic GPipe fill +
+drain bubble). The whole schedule is a ``lax.scan`` inside ``shard_map``
+inside jit — reverse-mode differentiable, so the backward pipeline comes
+from autodiff for free (activations are rematerialized per-stage by XLA
+as needed).
+
+(PP is absent in the reference — SURVEY §2.2; with tp.py, moe.py,
+ring_attention.py and the DP loaders this completes dp/tp/pp/sp/ep.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of S identically-structured stage pytrees along a new
+    leading dim (shard it over ``pp`` with ``shard_pytree`` or let
+    ``pipeline_apply``'s in_specs do it)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
+                   axis: str = "pp"):
+    """Run ``x`` through S pipeline stages of ``stage_fn``.
+
+    stage_fn: ``(params, act) -> act`` — one stage's computation; the
+        activation shape must be stage-invariant.
+    stage_params: pytree whose leaves have leading dim S (stage-stacked).
+    x: ``(M, mb, ...)`` microbatches, replicated across the mesh.
+    Returns ``(M, mb, ...)`` outputs, replicated.
+    """
+    s = mesh.shape[axis]
+    m = x.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != s:
+            # Without this check a (2S, ...) stack on an S-device axis
+            # would silently run only every other stage.
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pp axis "
+                f"size {s}")
+
+    def body(params, xs):
+        stage = jax.lax.axis_index(axis)
+        my = jax.tree_util.tree_map(lambda l: l[0], params)
+        perm = [(j, (j + 1) % s) for j in range(s)]
+        buf = jnp.zeros(xs.shape[1:], xs.dtype)
+
+        def sched(buf, t):
+            # Stage 0 injects microbatch t (clamped during drain); other
+            # stages consume what arrived from upstream last step.
+            inject = jax.lax.dynamic_index_in_dim(
+                xs, jnp.minimum(t, m - 1), 0, keepdims=False)
+            act = jnp.where(stage == 0, inject, buf)
+            y = stage_fn(my, act)
+            return jax.lax.ppermute(y, axis, perm), y
+
+        _, ys = jax.lax.scan(sched, buf, jnp.arange(m + s - 1))
+        # ys[t] on the LAST stage at t >= s-1 is microbatch t-(s-1)'s
+        # output; broadcast it to every device so the result is
+        # replicated (a psum of a one-hot-by-stage contribution).
+        outs = jnp.where(stage == s - 1, ys[s - 1:], 0.0)
+        return jax.lax.psum(outs, axis)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, x)

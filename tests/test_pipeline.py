@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe schedule over the pp axis. Oracle is
+exactness — pipelined forward and gradients must equal the sequential
+composition of the stages."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddstore_tpu.parallel import (make_mesh, pipeline_apply,
+                                  stack_stage_params)
+
+
+class StageMLP(nn.Module):
+    dim: int = 16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.tanh(nn.Dense(2 * self.dim)(x))
+        return x + nn.Dense(self.dim)(h)
+
+
+def _setup(s=4, m=8, mb=4, dim=16):
+    model = StageMLP(dim)
+    keys = jax.random.split(jax.random.key(0), s)
+    per_stage = [model.init(k, jnp.zeros((mb, dim))) for k in keys]
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.key(1), (m, mb, dim))
+    step = lambda p, a: model.apply(p, a)
+    return model, per_stage, stacked, x, step
+
+
+def _sequential(model, per_stage, x):
+    y = x.reshape(-1, x.shape[-1])
+    for p in per_stage:
+        y = model.apply(p, y)
+    return y.reshape(x.shape)
+
+
+def test_pipeline_forward_matches_sequential():
+    mesh = make_mesh({"pp": 4})
+    model, per_stage, stacked, x, step = _setup()
+    out = jax.jit(lambda p, a: pipeline_apply(step, p, a, mesh=mesh))(
+        stacked, x)
+    want = _sequential(model, per_stage, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    mesh = make_mesh({"pp": 4})
+    model, per_stage, stacked, x, step = _setup()
+    tgt = jax.random.normal(jax.random.key(2), x.shape)
+
+    def loss_pp(p):
+        out = pipeline_apply(step, p, x, mesh=mesh)
+        return jnp.mean((out - tgt) ** 2)
+
+    def loss_seq(ps):
+        return jnp.mean((_sequential(model, ps, x) - tgt) ** 2)
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    g_seq = jax.grad(loss_seq)(per_stage)
+    g_seq_stacked = stack_stage_params(g_seq)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_pipeline_with_dp_axis_present():
+    """pp works on a mesh that also has other axes (pp×dp), params
+    sharded over pp only."""
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    model, per_stage, stacked, x, step = _setup()
+    out = jax.jit(lambda p, a: pipeline_apply(step, p, a, mesh=mesh))(
+        stacked, x)
+    want = _sequential(model, per_stage, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_trains():
+    """A pipelined 4-stage MLP fits a regression target."""
+    mesh = make_mesh({"pp": 4})
+    model, per_stage, stacked, x, step = _setup()
+    y = x * 0.5 + 1.0
+
+    @jax.jit
+    def loss_fn(p):
+        return jnp.mean((pipeline_apply(step, p, x, mesh=mesh) - y) ** 2)
+
+    import optax
+    tx = optax.adam(1e-2)
+    opt = tx.init(stacked)
+    p = stacked
+    l0 = float(loss_fn(p))
+    for _ in range(60):
+        g = jax.jit(jax.grad(loss_fn))(p)
+        upd, opt = tx.update(g, opt, p)
+        p = optax.apply_updates(p, upd)
+    assert float(loss_fn(p)) < l0 * 0.2
