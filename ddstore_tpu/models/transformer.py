@@ -90,6 +90,8 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None   # enables ring attention when sp > 1
     sp_axis: str = "sp"
     n_experts: int = 0            # > 0 swaps the MLP for a switch-MoE
+    remat: bool = False           # rematerialize blocks (long context:
+    #                               trade recompute for activation memory)
 
     @nn.compact
     def __call__(self, tokens, positions):
@@ -105,10 +107,11 @@ class TransformerLM(nn.Module):
         ang = positions[..., None].astype(jnp.float32) * freqs
         pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
         x = x + pe.astype(self.compute_dtype)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.layers):
-            x = Block(self.dim, self.heads, self.mlp_ratio,
-                      self.compute_dtype, self.mesh, self.sp_axis,
-                      n_experts=self.n_experts, name=f"block{i}")(x)
+            x = block_cls(self.dim, self.heads, self.mlp_ratio,
+                          self.compute_dtype, self.mesh, self.sp_axis,
+                          n_experts=self.n_experts, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
         return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                         name="head")(x)

@@ -55,14 +55,24 @@ class TcpTransport : public Transport {
   int world() const override { return world_; }
 
  private:
-  struct Peer {
-    std::string host;
-    int port = -1;
+  // One TCP connection to a peer. A peer owns a small pool of these
+  // (DDSTORE_CONNS_PER_PEER, default 4): a single stream can't saturate
+  // loopback/DCN, and each connection gets its own serving thread on the
+  // target, so large reads stripe across streams and server cores.
+  struct Conn {
     int fd = -1;
     std::mutex mu;  // serializes use of this connection
   };
+  struct Peer {
+    std::string host;
+    int port = -1;
+    std::vector<std::unique_ptr<Conn>> conns;
+  };
 
-  int EnsureConnected(Peer& p);
+  int EnsureConnected(Peer& p, Conn& c);
+  // The pipelined request/response loop over one connection.
+  int ReadVOn(Peer& p, Conn& c, const std::string& name, const ReadOp* ops,
+              int64_t n);
   void AcceptLoop();
   void HandleConnection(int fd);
 
