@@ -16,16 +16,36 @@ import threading
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
-_LIB_PATH = os.path.join(_LIB_DIR, "libddstore_tpu.so")
-_SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc", "capi.cc"]
-_HEADERS = ["store.h", "local_transport.h", "tcp_transport.h"]
+_SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
+            "worker_pool.cc", "capi.cc"]
+_HEADERS = ["store.h", "local_transport.h", "tcp_transport.h",
+            "worker_pool.h"]
 _lock = threading.Lock()
 
+# Sanitizer builds (SURVEY §5: the reference has no TSan/ASan anywhere; the
+# shared_mutex-heavy core + serving threads are exactly the code that needs
+# them). DDSTORE_SANITIZE=thread|address selects a separately-cached .so so
+# plain and sanitized builds don't evict each other.
+_SANITIZERS = {"thread": "-fsanitize=thread", "address": "-fsanitize=address"}
 
-def _stale() -> bool:
-    if not os.path.exists(_LIB_PATH):
+
+def _sanitize_mode() -> str:
+    mode = os.environ.get("DDSTORE_SANITIZE", "").strip().lower()
+    if mode and mode not in _SANITIZERS:
+        raise ValueError(
+            f"DDSTORE_SANITIZE={mode!r}: expected one of {set(_SANITIZERS)}")
+    return mode
+
+
+def _lib_path(mode: str) -> str:
+    suffix = f"_{mode}" if mode else ""
+    return os.path.join(_LIB_DIR, f"libddstore_tpu{suffix}.so")
+
+
+def _stale(lib_path: str) -> bool:
+    if not os.path.exists(lib_path):
         return True
-    lib_mtime = os.path.getmtime(_LIB_PATH)
+    lib_mtime = os.path.getmtime(lib_path)
     for f in _SOURCES + _HEADERS:
         if os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime:
             return True
@@ -34,20 +54,25 @@ def _stale() -> bool:
 
 def build(force: bool = False) -> str:
     """Returns the path to the built shared library, compiling if needed."""
+    mode = _sanitize_mode()
+    lib_path = _lib_path(mode)
     with _lock:
-        if not force and not _stale():
-            return _LIB_PATH
+        if not force and not _stale(lib_path):
+            return lib_path
         # Installed wheels bundle the library (setup.py build_native); the
         # site-packages tree may be read-only, so fall back to the bundled
         # lib rather than insisting on a rebuild.
-        if os.path.exists(_LIB_PATH) and not os.access(_LIB_DIR, os.W_OK):
-            return _LIB_PATH
+        if os.path.exists(lib_path) and not os.access(_LIB_DIR, os.W_OK):
+            return lib_path
         os.makedirs(_LIB_DIR, exist_ok=True)
         cxx = os.environ.get("DDSTORE_CXX", "g++")
         cmd = [
             cxx, "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
             "-Wall",
         ]
+        if mode:
+            # -O1 + frame pointers give usable sanitizer reports.
+            cmd += [_SANITIZERS[mode], "-O1", "-fno-omit-frame-pointer", "-g"]
         cmd += [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
         # Build to a temp path then rename: concurrent test processes may
         # race on the build, and dlopen of a half-written .so is fatal.
@@ -56,11 +81,11 @@ def build(force: bool = False) -> str:
         try:
             subprocess.run(cmd + ["-o", tmp], check=True, capture_output=True,
                            text=True)
-            os.replace(tmp, _LIB_PATH)
+            os.replace(tmp, lib_path)
         except subprocess.CalledProcessError as e:  # pragma: no cover
             raise RuntimeError(
                 f"native build failed:\n{e.stderr}") from e
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
-        return _LIB_PATH
+        return lib_path

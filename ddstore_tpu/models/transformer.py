@@ -25,6 +25,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention, mha_reference
+from ..parallel.pipeline import pipeline_apply, stack_stage_params
 from ..parallel.ring_attention import ring_attention
 from ..parallel.tp import (expert_rules, megatron_rules, shard_pytree,
                            shardings_of)
@@ -57,7 +58,7 @@ class Block(nn.Module):
         if use_sp:
             out, _ = ring_attention(q, k, v, mesh=self.mesh,
                                     axis=self.sp_axis, causal=True)
-        elif jax.default_backend() == "tpu" and s % 128 == 0:
+        elif jax.default_backend() == "tpu" and s % 8 == 0:
             out, _ = flash_attention(q, k, v, causal=True)
         else:
             out, _ = mha_reference(q, k, v, causal=True)
@@ -80,6 +81,40 @@ class Block(nn.Module):
         return x
 
 
+class EmbedPE(nn.Module):
+    """Token embedding + fixed sinusoidal positions. Stateless PE works at
+    any context length and is exact under sequence sharding (depends only
+    on the global position values handed in). A submodule so the pipelined
+    step applies the SAME code outside the ring (no duplicated math)."""
+
+    vocab: int
+    dim: int
+    compute_dtype: Any
+
+    @nn.compact
+    def __call__(self, tokens, positions):
+        x = nn.Embed(self.vocab, self.dim, dtype=self.compute_dtype,
+                     name="tok")(tokens)
+        half = self.dim // 2
+        freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+        ang = positions[..., None].astype(jnp.float32) * freqs
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        return x + pe.astype(self.compute_dtype)
+
+
+class LMHead(nn.Module):
+    """Final LayerNorm + vocab projection (shared by the sequential and
+    pipelined steps)."""
+
+    vocab: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
+        return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
+                        name="head")(x)
+
+
 class TransformerLM(nn.Module):
     vocab: int = 1024
     dim: int = 256
@@ -97,24 +132,14 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, positions):
         """tokens/positions: (B, S) int32; positions are GLOBAL indices so
         sequence-sharded chunks embed correctly."""
-        x = nn.Embed(self.vocab, self.dim, dtype=self.compute_dtype,
-                     name="tok")(tokens)
-        # Fixed sinusoidal positions: stateless, any context length,
-        # exact under sequence sharding (depends only on the global
-        # position values handed in).
-        half = self.dim // 2
-        freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
-        ang = positions[..., None].astype(jnp.float32) * freqs
-        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
-        x = x + pe.astype(self.compute_dtype)
+        x = EmbedPE(self.vocab, self.dim, self.compute_dtype,
+                    name="embed")(tokens, positions)
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.layers):
             x = block_cls(self.dim, self.heads, self.mlp_ratio,
                           self.compute_dtype, self.mesh, self.sp_axis,
                           n_experts=self.n_experts, name=f"block{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
-        return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
-                        name="head")(x)
+        return LMHead(self.vocab, name="lmhead")(x)
 
 
 def loss_fn(logits, targets):
@@ -205,4 +230,148 @@ def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,
     seq = NamedSharding(mesh, P(dp, sp))
     return jax.jit(step, in_shardings=(state_sh, seq, seq, seq),
                    out_shardings=(state_sh, repl),
+                   donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism: the LM split into stages (dp×pp composition).
+#
+# The homogeneous middle (the transformer blocks) runs through
+# pipeline_apply with block-group parameters stacked along a leading
+# stage dim sharded over pp; the heterogeneous ends (embedding + position
+# encoding, final LayerNorm + LM head) run outside the ring, batch-
+# sharded over dp. Their parameters are a few percent of the total, so
+# the pp memory win — each device holds layers/S of the blocks — is
+# preserved. (PP absent in the reference, SURVEY §2.2.)
+# ---------------------------------------------------------------------------
+
+
+def lm_to_stages(params, layers: int, n_stages: int):
+    """Split TransformerLM params into (outer, stage-stacked blocks).
+
+    outer keeps embed/lmhead; the blocks are grouped into ``n_stages``
+    contiguous groups of ``layers // n_stages`` and stacked along a new
+    leading stage dim (see ``stack_stage_params``).
+    """
+    if layers % n_stages:
+        raise ValueError(f"n_stages {n_stages} must divide layers {layers}")
+    g = layers // n_stages
+    p = params["params"]
+    outer = {k: v for k, v in p.items() if not k.startswith("block")}
+    per_stage = [
+        {f"layer{j}": p[f"block{st * g + j}"] for j in range(g)}
+        for st in range(n_stages)
+    ]
+    return {"params": outer}, stack_stage_params(per_stage)
+
+
+def lm_from_stages(outer, stages, layers: int, n_stages: int):
+    """Inverse of ``lm_to_stages`` (for checkpoints / oracle tests)."""
+    g = layers // n_stages
+    p = dict(outer["params"])
+    for st in range(n_stages):
+        for j in range(g):
+            p[f"block{st * g + j}"] = jax.tree_util.tree_map(
+                lambda l: l[st], stages[f"layer{j}"])
+    return {"params": p}
+
+
+def _embed_apply(model: "TransformerLM", outer, tokens, positions):
+    return EmbedPE(model.vocab, model.dim, model.compute_dtype).apply(
+        {"params": outer["params"]["embed"]}, tokens, positions)
+
+
+def _head_apply(model: "TransformerLM", outer, x):
+    return LMHead(model.vocab).apply(
+        {"params": outer["params"]["lmhead"]}, x)
+
+
+def _make_stage_fn(model: "TransformerLM", n_stages: int):
+    g = model.layers // n_stages
+    blk = Block(model.dim, model.heads, model.mlp_ratio,
+                model.compute_dtype, None, model.sp_axis,
+                n_experts=model.n_experts)
+
+    def stage_fn(stage_params, x):
+        for j in range(g):
+            x = blk.apply({"params": stage_params[f"layer{j}"]}, x)
+        return x
+
+    return stage_fn
+
+
+def create_pp_train_state(rng: jax.Array, model: TransformerLM,
+                          n_stages: int, lr: float = 3e-4,
+                          mesh: Optional[Mesh] = None, pp_axis: str = "pp"
+                          ) -> Tuple[TrainState, optax.GradientTransformation]:
+    """TrainState whose params are ``(outer, stages)`` with the stage
+    stack sharded over ``pp`` (optimizer state inherits the placement)."""
+    tok = jnp.zeros((1, 8), jnp.int32)
+    params = model.clone(mesh=None).init(rng, tok,
+                                         jnp.tile(jnp.arange(8), (1, 1)))
+    outer, stages = lm_to_stages(params, model.layers, n_stages)
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        st = NamedSharding(mesh, P(pp_axis))
+        outer = jax.device_put(outer, repl)
+        stages = jax.device_put(stages, st)
+    tx = optax.adam(lr)
+    pp_params = (outer, stages)
+    state = TrainState(pp_params, tx.init(pp_params),
+                       jnp.zeros((), jnp.int32))
+    if mesh is not None:
+        fix = lambda x: x if isinstance(getattr(x, "sharding", None),
+                                        NamedSharding) else \
+            jax.device_put(x, repl)
+        state = jax.tree_util.tree_map(fix, state)
+    return state, tx
+
+
+def make_pp_train_step(model: TransformerLM,
+                       tx: optax.GradientTransformation, mesh: Mesh,
+                       n_stages: int, n_microbatches: int,
+                       pp_axis: str = "pp", dp_axis: str = "dp",
+                       donate: bool = True, remat: bool = False):
+    """Jitted dp×pp train step over ``(tokens, targets, positions)``.
+
+    The batch dim must be ``n_microbatches * mb`` with ``mb`` divisible
+    by the dp axis. Embed/head run dp-sharded outside the ring; the
+    block stages stream microbatches through ``pipeline_apply``.
+    """
+    if model.n_experts > 0:
+        # The stage_fn applies blocks without mutable intermediates, so
+        # the MoE aux (load-balancing) loss would be silently dropped —
+        # experts would collapse with no error. Refuse rather than
+        # mistrain; compose pp with dense blocks, or ep without pp.
+        raise NotImplementedError(
+            "pipeline parallelism does not yet thread the MoE aux loss; "
+            "use make_train_step with an ep mesh for MoE models")
+    stage_fn = _make_stage_fn(model, n_stages)
+    dp = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
+
+    def step(state: TrainState, tokens, targets, positions):
+        def lossf(pp_params):
+            outer, stages = pp_params
+            x = _embed_apply(model, outer, tokens, positions)
+            b = x.shape[0]
+            mb = b // n_microbatches
+            xm = x.reshape(n_microbatches, mb, *x.shape[1:])
+            ym = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
+                                axis=pp_axis, dp_axis=dp, remat=remat)
+            y = ym.reshape(b, *ym.shape[2:])
+            logits = _head_apply(model, outer, y)
+            return loss_fn(logits, targets)
+
+        loss, grads = jax.value_and_grad(lossf)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    repl = NamedSharding(mesh, P())
+    seq = NamedSharding(mesh, P(dp, None))
+    # State shardings are inferred from the committed placement that
+    # create_pp_train_state established (outer replicated, stages over
+    # pp); only the data and the replicated loss are pinned here.
+    return jax.jit(step, in_shardings=(None, seq, seq, seq),
+                   out_shardings=(None, repl),
                    donate_argnums=(0,) if donate else ())

@@ -2,7 +2,6 @@
 
 #include <algorithm>
 #include <cstring>
-#include <thread>
 
 namespace dds {
 
@@ -167,9 +166,10 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   }
 
   // Partition runs by peer; serve local runs in one vectored call (one
-  // lock + lookup for the whole batch), issue one worker thread per
-  // distinct remote peer. Each peer's runs go through one pipelined
-  // ReadV (1 round trip amortized over all runs to that peer).
+  // lock + lookup for the whole batch), then hand ALL remote peers' run
+  // lists to the transport in one ReadVMulti — concurrency across peers
+  // (and across striped connections within a peer) comes from the
+  // transport's persistent worker pool, not from per-call thread spawns.
   std::map<int, std::vector<ReadOp>> by_peer;
   std::vector<ReadOp> local_ops;
   char* out = static_cast<char*>(dst);
@@ -187,22 +187,13 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   }
   if (by_peer.empty()) return kOk;
 
-  std::vector<std::thread> workers;
-  std::vector<int> rcs(by_peer.size(), kOk);
-  size_t wi = 0;
-  for (auto& kv : by_peer) {
-    int peer = kv.first;
-    std::vector<ReadOp>* ops = &kv.second;
-    int* rc = &rcs[wi++];
-    workers.emplace_back([this, peer, ops, &name, rc]() {
-      *rc = transport_->ReadV(peer, name, ops->data(),
-                              static_cast<int64_t>(ops->size()));
-    });
-  }
-  for (auto& t : workers) t.join();
-  for (int c : rcs)
-    if (c != kOk) return c;
-  return kOk;
+  std::vector<PeerReadV> reqs;
+  reqs.reserve(by_peer.size());
+  for (auto& kv : by_peer)
+    reqs.push_back(PeerReadV{kv.first, kv.second.data(),
+                             static_cast<int64_t>(kv.second.size())});
+  return transport_->ReadVMulti(name, reqs.data(),
+                                static_cast<int64_t>(reqs.size()));
 }
 
 int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,

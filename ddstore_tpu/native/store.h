@@ -68,6 +68,14 @@ struct ReadOp {
   void* dst;
 };
 
+// One peer's portion of a batched read (GetBatch partitions its coalesced
+// runs by owner and hands the whole set to the transport at once).
+struct PeerReadV {
+  int target;
+  const ReadOp* ops;
+  int64_t n;
+};
+
 // One-sided read transport. Implementations must be thread-safe: get_batch
 // issues reads to distinct peers concurrently.
 class Transport {
@@ -93,8 +101,25 @@ class Transport {
     return 0;
   }
 
-  // Collective tagged barrier across the group. Every rank must call with the
-  // same sequence of tags.
+  // Batched multi-peer read: every entry's ops go to its target, with
+  // whatever concurrency the transport can supply (the TCP transport runs
+  // them on a persistent worker pool). Default: sequential ReadV per peer,
+  // stopping at the first error.
+  virtual int ReadVMulti(const std::string& name, const PeerReadV* reqs,
+                         int64_t nreqs) {
+    for (int64_t i = 0; i < nreqs; ++i) {
+      int rc = ReadV(reqs[i].target, name, reqs[i].ops, reqs[i].n);
+      if (rc != 0) return rc;
+    }
+    return 0;
+  }
+
+  // Collective tagged barrier across the group. Every rank must issue the
+  // same serialized sequence of Barrier calls (matching is positional —
+  // the TCP transport pairs barriers by an internal per-transport
+  // collective sequence number, since callers' tags come from independent
+  // subsystems and are not globally ordered; the tag itself is carried
+  // only for debugging/diagnostics).
   virtual int Barrier(int64_t tag) = 0;
 
   virtual int rank() const = 0;

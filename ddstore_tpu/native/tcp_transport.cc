@@ -9,6 +9,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
 #include <chrono>
 #include <cstdio>
@@ -145,11 +146,17 @@ long EnvLong(const char* name, long dflt) {
 }  // namespace
 
 TcpTransport::TcpTransport(int rank, int world, int port)
-    : rank_(rank), world_(world) {
+    : rank_(rank), world_(world),
+      pool_(static_cast<int>(EnvLong(
+          "DDSTORE_POOL_THREADS",
+          std::min(64u, std::max(4u, std::thread::hardware_concurrency()))))) {
   listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   if (listen_fd_ < 0) return;
   int one = 1;
   ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // Accepted sockets inherit the listen socket's buffer sizes; this is the
+  // point where they must be set for window scaling to be negotiated.
+  SetBufSizes(listen_fd_);
   sockaddr_in addr;
   std::memset(&addr, 0, sizeof(addr));
   addr.sin_family = AF_INET;
@@ -250,15 +257,21 @@ void TcpTransport::HandleConnection(int fd) {
       // One-way: no response. An acked design deadlocks at teardown — a
       // rank that passes the barrier may close before acking, failing the
       // late peer's notify loop midway so the remaining peers never get
-      // notified and wait out the full timeout.
+      // notified and wait out the full timeout. The dissemination round
+      // rides in req.offset.
       {
         std::lock_guard<std::mutex> lock(barrier_mu_);
-        ++barrier_arrived_[req.tag];
-        if (DebugOn())
-          std::fprintf(stderr, "[dds r%d] barrier notify from r%d tag=%lld "
-                       "count=%lld\n", rank_, req.src,
-                       static_cast<long long>(req.tag),
-                       static_cast<long long>(barrier_arrived_[req.tag]));
+        // req.tag carries the sender's collective sequence number. Drop
+        // notifies for retired seqs: recreating an erased entry would
+        // leak it forever (seqs are never reused).
+        if (req.tag > retired_seq_) {
+          int round = static_cast<int>(req.offset);
+          ++barrier_arrived_[{req.tag, round}];
+          if (DebugOn())
+            std::fprintf(stderr, "[dds r%d] barrier notify from r%d "
+                         "seq=%lld round=%d\n", rank_, req.src,
+                         static_cast<long long>(req.tag), round);
+        }
       }
       barrier_cv_.notify_all();
       continue;
@@ -319,6 +332,7 @@ int TcpTransport::EnsureConnected(Peer& p, Conn& c) {
   for (addrinfo* ai = res; ai; ai = ai->ai_next) {
     fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
+    SetBufSizes(fd);  // must precede connect() for window scaling
     while (::connect(fd, ai->ai_addr, ai->ai_addrlen) < 0) {
       if ((errno == ECONNREFUSED || errno == ETIMEDOUT) &&
           std::chrono::steady_clock::now() < deadline &&
@@ -399,102 +413,154 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
 // A single TCP stream can't saturate loopback or a DCN NIC. Large requests
 // are split into ~kStripeBytes pieces and the op list is partitioned
 // round-robin by bytes across the peer's connection pool; each pool member
-// runs the pipelined loop on its own thread against its own serving thread
-// on the target.
+// runs the pipelined loop against its own serving thread on the target.
 constexpr int64_t kStripeBytes = 1 << 22;
 
 int TcpTransport::ReadV(int target, const std::string& name, const ReadOp* ops,
                         int64_t n) {
-  if (target < 0 || target >= world_ || target == rank_) return kErrInvalidArg;
-  Peer& p = *peers_[target];
-  const int nconn = static_cast<int>(p.conns.size());
+  PeerReadV req{target, ops, n};
+  return ReadVMulti(name, &req, 1);
+}
 
-  // Total bytes decide whether striping is worth the thread fan-out.
-  int64_t total = 0;
-  for (int64_t i = 0; i < n; ++i) total += ops[i].nbytes;
-  if (nconn <= 1 || total < 2 * kStripeBytes)
-    return ReadVOn(p, *p.conns[0], name, ops, n);
+int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
+                             int64_t nreqs) {
+  // Flatten peers × striped connections into one leaf-task list, then run
+  // the leaves on the persistent pool (one inline for guaranteed
+  // progress). Flat leaves mean pool tasks never wait on nested pool
+  // tasks, so the pool cannot self-deadlock.
+  struct Leaf {
+    Peer* p;
+    Conn* c;
+    std::vector<ReadOp> ops;
+  };
+  std::vector<Leaf> leaves;
+  for (int64_t ri = 0; ri < nreqs; ++ri) {
+    const PeerReadV& rq = reqs[ri];
+    if (rq.target < 0 || rq.target >= world_ || rq.target == rank_)
+      return kErrInvalidArg;
+    if (rq.n == 0) continue;
+    Peer& p = *peers_[rq.target];
+    const int nconn = static_cast<int>(p.conns.size());
 
-  // Chunk big ops, then deal chunks round-robin (they are similar sizes,
-  // so this balances bytes well without a sort).
-  std::vector<std::vector<ReadOp>> lists(nconn);
-  int next = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    int64_t off = ops[i].offset, left = ops[i].nbytes;
-    char* dst = static_cast<char*>(ops[i].dst);
-    while (left > 0) {
-      int64_t take = left < kStripeBytes ? left : kStripeBytes;
-      lists[next].push_back(ReadOp{off, take, dst});
-      next = (next + 1) % nconn;
-      off += take;
-      dst += take;
-      left -= take;
+    // Total bytes decide whether striping is worth the fan-out.
+    int64_t total = 0;
+    for (int64_t i = 0; i < rq.n; ++i) total += rq.ops[i].nbytes;
+    if (nconn <= 1 || total < 2 * kStripeBytes) {
+      leaves.push_back(Leaf{&p, p.conns[0].get(),
+                            std::vector<ReadOp>(rq.ops, rq.ops + rq.n)});
+      continue;
     }
-  }
 
-  std::vector<std::thread> workers;
-  std::vector<int> rcs(nconn, kOk);
-  for (int ci = 1; ci < nconn; ++ci) {
-    if (lists[ci].empty()) continue;
-    workers.emplace_back([this, &p, &name, &lists, &rcs, ci]() {
-      rcs[ci] = ReadVOn(p, *p.conns[ci], name, lists[ci].data(),
-                        static_cast<int64_t>(lists[ci].size()));
+    // Chunk big ops, then deal chunks round-robin (they are similar
+    // sizes, so this balances bytes well without a sort).
+    std::vector<std::vector<ReadOp>> lists(nconn);
+    int next = 0;
+    for (int64_t i = 0; i < rq.n; ++i) {
+      int64_t off = rq.ops[i].offset, left = rq.ops[i].nbytes;
+      char* dst = static_cast<char*>(rq.ops[i].dst);
+      while (left > 0) {
+        int64_t take = left < kStripeBytes ? left : kStripeBytes;
+        lists[next].push_back(ReadOp{off, take, dst});
+        next = (next + 1) % nconn;
+        off += take;
+        dst += take;
+        left -= take;
+      }
+    }
+    for (int ci = 0; ci < nconn; ++ci)
+      if (!lists[ci].empty())
+        leaves.push_back(Leaf{&p, p.conns[ci].get(), std::move(lists[ci])});
+  }
+  if (leaves.empty()) return kOk;
+
+  std::vector<int> rcs(leaves.size(), kOk);
+  TaskGroup group(&pool_);
+  for (size_t li = 1; li < leaves.size(); ++li) {
+    Leaf* lf = &leaves[li];
+    int* rc = &rcs[li];
+    group.Launch([this, lf, &name, rc]() {
+      *rc = ReadVOn(*lf->p, *lf->c, name, lf->ops.data(),
+                    static_cast<int64_t>(lf->ops.size()));
     });
   }
-  if (!lists[0].empty())
-    rcs[0] = ReadVOn(p, *p.conns[0], name, lists[0].data(),
-                     static_cast<int64_t>(lists[0].size()));
-  for (auto& t : workers) t.join();
+  rcs[0] = ReadVOn(*leaves[0].p, *leaves[0].c, name, leaves[0].ops.data(),
+                   static_cast<int64_t>(leaves[0].ops.size()));
+  group.Wait();
   for (int rc : rcs)
     if (rc != kOk) return rc;
   return kOk;
 }
 
+bool TcpTransport::SendBarrierNotify(int target, int64_t seq, int round) {
+  Peer& p = *peers_[target];
+  Conn& c = *p.conns[0];
+  std::lock_guard<std::mutex> lock(c.mu);
+  // round rides in the offset field (unused by barrier frames).
+  WireReq req{kMagic, kOpBarrier, rank_, 0, round, 0, seq};
+  return EnsureConnected(p, c) == kOk &&
+         FullSend(c.fd, &req, sizeof(req)) == 0;
+}
+
 int TcpTransport::Barrier(int64_t tag) {
-  // Notify every peer (one-way, best-effort), then wait until every peer
-  // has notified us. Notify failures are not immediately fatal: the common
-  // benign case is a peer that already passed this barrier and tore down —
-  // its own notify to us was delivered before it exited. A peer that truly
-  // died early can never notify us, and the wait timeout surfaces that as
-  // kErrTransport (failure detection; the reference has none, SURVEY §5).
-  for (int r = 0; r < world_; ++r) {
-    if (r == rank_) continue;
-    Peer& p = *peers_[r];
-    Conn& c = *p.conns[0];
-    std::lock_guard<std::mutex> lock(c.mu);
-    WireReq req{kMagic, kOpBarrier, rank_, 0, 0, 0, tag};
-    bool sent = EnsureConnected(p, c) == kOk &&
-                FullSend(c.fd, &req, sizeof(req)) == 0;
-    if (!sent && DebugOn())
-      std::fprintf(stderr, "[dds r%d] barrier tag=%lld notify r%d failed\n",
-                   rank_, static_cast<long long>(tag), r);
-  }
+  // Dissemination barrier: in round k every rank notifies
+  // (rank + 2^k) % P (one-way, best-effort) and waits for the round-k
+  // notify from (rank - 2^k) mod P — after ceil(log2 P) rounds each rank
+  // has transitively heard from all others. O(P log P) total messages and
+  // O(log P) serial latency instead of round 1's flat notify loop
+  // (O(P^2) messages, O(P) serial sends under each conn mutex).
+  //
+  // Notify failures are not immediately fatal: the common benign case is
+  // a peer that already passed this barrier and tore down — the
+  // information it owed us was delivered before it exited. A peer that
+  // truly died early can never notify us, and the per-round wait timeout
+  // surfaces that as kErrTransport with the expected sender named
+  // (failure detection; the reference has none, SURVEY §5).
   long timeout_s = 300;
   if (const char* env = ::getenv("DDSTORE_BARRIER_TIMEOUT_S")) {
     char* end = nullptr;
     long v = std::strtol(env, &end, 10);
     if (end != env && v > 0) timeout_s = v;
   }
-  std::unique_lock<std::mutex> lock(barrier_mu_);
-  bool ok = barrier_cv_.wait_for(lock, std::chrono::seconds(timeout_s), [&] {
-    auto it = barrier_arrived_.find(tag);
-    return it != barrier_arrived_.end() && it->second >= world_ - 1;
-  });
-  if (!ok) {
-    auto it = barrier_arrived_.find(tag);
-    std::fprintf(stderr, "[dds r%d] barrier tag=%lld timed out after %lds "
-                 "(%lld/%d peers arrived)\n", rank_,
-                 static_cast<long long>(tag), timeout_s,
-                 static_cast<long long>(
-                     it == barrier_arrived_.end() ? 0 : it->second),
-                 world_ - 1);
-    // Erase on timeout too: tags are never reused (callers increment), so
-    // a stale partial count is pure leak + misleading later debug output.
-    if (it != barrier_arrived_.end()) barrier_arrived_.erase(it);
-    return kErrTransport;
+  int rounds = 0;
+  while ((1 << rounds) < world_) ++rounds;
+  int64_t seq;
+  {
+    std::lock_guard<std::mutex> lock(barrier_mu_);
+    seq = ++barrier_seq_;
   }
-  barrier_arrived_.erase(tag);
-  return kOk;
+
+  int result = kOk;
+  for (int k = 0; k < rounds; ++k) {
+    int to = (rank_ + (1 << k)) % world_;
+    int from = (rank_ - (1 << k) + world_) % world_;
+    if (!SendBarrierNotify(to, seq, k) && DebugOn())
+      std::fprintf(stderr, "[dds r%d] barrier tag=%lld seq=%lld notify "
+                   "r%d failed\n", rank_, static_cast<long long>(tag),
+                   static_cast<long long>(seq), to);
+    std::unique_lock<std::mutex> lock(barrier_mu_);
+    bool ok = barrier_cv_.wait_for(
+        lock, std::chrono::seconds(timeout_s), [&] {
+          auto it = barrier_arrived_.find({seq, k});
+          return it != barrier_arrived_.end() && it->second >= 1;
+        });
+    if (!ok) {
+      std::fprintf(stderr, "[dds r%d] barrier tag=%lld seq=%lld round "
+                   "%d/%d timed out after %lds waiting for r%d\n", rank_,
+                   static_cast<long long>(tag),
+                   static_cast<long long>(seq), k, rounds, timeout_s, from);
+      result = kErrTransport;
+      break;
+    }
+  }
+  // Retire the seq win or lose: erase every entry at or below it and
+  // raise the high-water mark so a straggler's late notify is dropped
+  // instead of recreating (and leaking) an entry.
+  std::lock_guard<std::mutex> lock(barrier_mu_);
+  if (seq > retired_seq_) retired_seq_ = seq;
+  barrier_arrived_.erase(
+      barrier_arrived_.begin(),
+      barrier_arrived_.upper_bound({seq, INT32_MAX}));
+  return result;
 }
 
 }  // namespace dds

@@ -5,10 +5,20 @@ leading stage dimension sharded over ``pp`` (so each device holds one
 stage). Microbatches stream through the ring: at every schedule step each
 device applies its stage to the activation it holds and ``ppermute``s the
 result to the next stage, for M + S - 1 steps (the classic GPipe fill +
-drain bubble). The whole schedule is a ``lax.scan`` inside ``shard_map``
-inside jit — reverse-mode differentiable, so the backward pipeline comes
-from autodiff for free (activations are rematerialized per-stage by XLA
-as needed).
+drain bubble — idle fraction (S-1)/(M+S-1)). The whole schedule is a
+``lax.scan`` inside ``shard_map`` inside jit — reverse-mode
+differentiable, so the backward pipeline comes from autodiff for free.
+
+Composition with data parallelism: pass ``dp_axis`` and the microbatch
+dimension of ``x`` is sharded across ``dp`` — each (dp, pp) device holds
+1/dp of every microbatch and 1/pp of the parameters. The ``ppermute``
+moves activations stage-to-stage within a dp slice only; nothing is
+replicated (this fixes round-1's version, which kept the full microbatch
+tensor on every device). Memory per device for activations is
+O(M · mb/dp); pass ``remat=True`` to rematerialize each stage in the
+backward pass (GPipe's activation-memory trick — with per-stage remat
+the live set during backward is one stage's activations, the same
+working set a 1F1B schedule targets).
 
 (PP is absent in the reference — SURVEY §2.2; with tp.py, moe.py,
 ring_attention.py and the DP loaders this completes dp/tp/pp/sp/ep.)
@@ -16,7 +26,7 @@ ring_attention.py and the DP loaders this completes dp/tp/pp/sp/ep.)
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,14 +44,18 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
-                   axis: str = "pp"):
+                   axis: str = "pp", dp_axis: Optional[str] = None,
+                   remat: bool = False):
     """Run ``x`` through S pipeline stages of ``stage_fn``.
 
     stage_fn: ``(params, act) -> act`` — one stage's computation; the
         activation shape must be stage-invariant.
-    stage_params: pytree whose leaves have leading dim S (stage-stacked).
-    x: ``(M, mb, ...)`` microbatches, replicated across the mesh.
-    Returns ``(M, mb, ...)`` outputs, replicated.
+    stage_params: pytree whose leaves have leading dim S (stage-stacked);
+        sharded over ``axis``, replicated over the other mesh axes.
+    x: ``(M, mb, ...)`` microbatches. With ``dp_axis`` the ``mb`` dim is
+        sharded over it; otherwise x is replicated (small-input path).
+    remat: rematerialize ``stage_fn`` in the backward pass.
+    Returns ``(M, mb, ...)`` outputs with the same sharding as ``x``.
     """
     s = mesh.shape[axis]
     m = x.shape[0]
@@ -52,6 +66,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
             raise ValueError(
                 f"stage_params leading dim {leaf.shape[0]} != pp axis "
                 f"size {s}")
+    if dp_axis is not None and x.shape[1] % mesh.shape[dp_axis]:
+        raise ValueError(
+            f"dp axis size {mesh.shape[dp_axis]} must divide microbatch "
+            f"size {x.shape[1]}")
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def body(params, xs):
         stage = jax.lax.axis_index(axis)
@@ -65,19 +84,20 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
             inject = jax.lax.dynamic_index_in_dim(
                 xs, jnp.minimum(t, m - 1), 0, keepdims=False)
             act = jnp.where(stage == 0, inject, buf)
-            y = stage_fn(my, act)
+            y = fn(my, act)
             return jax.lax.ppermute(y, axis, perm), y
 
         _, ys = jax.lax.scan(sched, buf, jnp.arange(m + s - 1))
         # ys[t] on the LAST stage at t >= s-1 is microbatch t-(s-1)'s
-        # output; broadcast it to every device so the result is
-        # replicated (a psum of a one-hot-by-stage contribution).
+        # output; zero elsewhere and psum over pp so every stage's copy
+        # of the (dp-sharded) output is identical.
         outs = jnp.where(stage == s - 1, ys[s - 1:], 0.0)
         return jax.lax.psum(outs, axis)
 
+    xspec = P(None, dp_axis) if dp_axis is not None else P()
     return jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), xspec),
+        out_specs=xspec,
         check_vma=False,
     )(stage_params, x)

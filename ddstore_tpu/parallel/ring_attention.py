@@ -11,9 +11,16 @@ key blocks — each step yields ``(out_i, lse_i)`` and the running pair is
 reweighted by ``exp(lse - m)`` — so the result is EXACT attention over the
 full sequence, with O(S/n) memory per device and n ring steps.
 
-The per-step block computation defaults to the XLA path
-(:func:`ddstore_tpu.ops.attention.mha_reference`, fused well by XLA); on
-TPU backends it can use the Pallas flash kernel once per self-chunk.
+On TPU the per-step block computation is the Pallas flash kernel, so each
+ring step is O(block) memory — without it each step materializes an
+(S/n)×(S/n) score matrix, capping exactly the context length the sp axis
+exists to extend. The kernel takes its causal offsets statically, while
+the ring offsets are traced (``axis_index``); with equal chunks every
+(device, step) pair is one of three STATIC cases — kv chunk fully in the
+past (unmasked flash), the diagonal chunk (plain causal flash at zero
+offset), or fully in the future (skipped) — so a ``lax.cond`` selects
+between statically-configured kernels. Non-TPU backends default to the
+XLA path (:func:`ddstore_tpu.ops.attention.mha_reference`).
 """
 
 from __future__ import annotations
@@ -25,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import mha_reference
+from ..ops.attention import flash_attention, mha_reference
 
 __all__ = ["ring_attention", "ring_self_attention"]
 
@@ -43,12 +50,17 @@ def _combine(acc_out, acc_lse, out_i, lse_i):
     return out, lse
 
 
-def _ring_body(q, k, v, *, axis: str, n: int, causal: bool):
+def _ring_body(q, k, v, *, axis: str, n: int, causal: bool,
+               use_flash: bool):
     """shard_map body: local chunks (B, H, S/n, D)."""
     idx = jax.lax.axis_index(axis)
     sq, sk = q.shape[2], k.shape[2]
     q_off = idx * sq
     perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def masked(args):
+        return (jnp.zeros(q.shape, q.dtype),
+                jnp.full(q.shape[:3], -jnp.inf, jnp.float32))
 
     acc_out = jnp.zeros(q.shape, jnp.float32)
     acc_lse = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
@@ -58,23 +70,40 @@ def _ring_body(q, k, v, *, axis: str, n: int, causal: bool):
         src = (idx - step) % n
         kv_off = src * sk
 
-        def attend(args):
-            qq, kk, vv = args
-            return mha_reference(qq, kk, vv, causal=causal,
-                                 q_offset=q_off, kv_offset=kv_off)
+        if use_flash:
+            # The kernel's offsets are static; the traced ring position
+            # reduces to three static mask shapes (module docstring).
+            def attend_past(args):
+                qq, kk, vv = args
+                return flash_attention(qq, kk, vv, causal=False)
 
-        if causal:
-            # A kv chunk entirely in this q chunk's future is fully
-            # masked: skip its O(S²/n²) compute on devices where that
-            # holds (half of all (device, step) pairs — the ring-level
-            # twin of the flash kernel's per-block `live` predicate).
-            out_i, lse_i = jax.lax.cond(
-                src <= idx, attend,
-                lambda args: (jnp.zeros(q.shape, q.dtype),
-                              jnp.full(q.shape[:3], -jnp.inf, jnp.float32)),
-                (q, k, v))
+            def attend_diag(args):
+                qq, kk, vv = args
+                return flash_attention(qq, kk, vv, causal=True)
+
+            if causal:
+                out_i, lse_i = jax.lax.cond(
+                    src == idx, attend_diag,
+                    lambda args: jax.lax.cond(src < idx, attend_past,
+                                              masked, args),
+                    (q, k, v))
+            else:
+                out_i, lse_i = attend_past((q, k, v))
         else:
-            out_i, lse_i = attend((q, k, v))
+            def attend(args):
+                qq, kk, vv = args
+                return mha_reference(qq, kk, vv, causal=causal,
+                                     q_offset=q_off, kv_offset=kv_off)
+
+            if causal:
+                # A kv chunk entirely in this q chunk's future is fully
+                # masked: skip its O(S²/n²) compute on devices where that
+                # holds (half of all (device, step) pairs — the ring-level
+                # twin of the flash kernel's per-block `live` predicate).
+                out_i, lse_i = jax.lax.cond(src <= idx, attend, masked,
+                                            (q, k, v))
+            else:
+                out_i, lse_i = attend((q, k, v))
         acc_out, acc_lse = _combine(acc_out, acc_lse, out_i, lse_i)
         if step < n - 1:
             k = jax.lax.ppermute(k, axis, perm)
@@ -84,27 +113,52 @@ def _ring_body(q, k, v, *, axis: str, n: int, causal: bool):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    mesh: Mesh, axis: str = "sp", causal: bool = False,
-                   batch_axis: Optional[str] = None
+                   batch_axis: Optional[str] = None,
+                   heads_axis: Optional[str] = None, impl: str = "auto"
                    ) -> Tuple[jax.Array, jax.Array]:
     """Exact attention over (B, H, S, D) with S sharded over ``axis``.
 
     Returns ``(out, lse)`` like the ops-level kernels. ``batch_axis``
     optionally shards B over a data-parallel mesh axis (defaults to "dp"
-    when the mesh has one). Callable inside jit: shard_map composes.
+    when the mesh has one); ``heads_axis`` shards H over a tensor-parallel
+    axis (sp×tp composition: heads are independent in attention, so each
+    tp shard rings only its own heads and the two axes compose without
+    any cross-communication). Callable inside jit: shard_map composes.
+
+    impl: "flash" (Pallas kernel per ring step — O(block) memory),
+    "xla" (mha_reference), or "auto" (flash on TPU when chunk shapes
+    allow, xla otherwise).
     """
     n = mesh.shape[axis]
     if batch_axis is None and "dp" in mesh.shape:
         batch_axis = "dp"
     bspec = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1) \
         else None
-    spec = P(bspec, None, axis, None)
+    hspec = heads_axis if (heads_axis
+                           and mesh.shape.get(heads_axis, 1) > 1) else None
+    spec = P(bspec, hspec, axis, None)
+    sq, sk = q.shape[2] // n, k.shape[2] // n
+    if impl == "auto":
+        use_flash = (jax.default_backend() == "tpu"
+                     and sq == sk and sq % 8 == 0)
+    elif impl in ("flash", "xla"):
+        # The static three-case causal split needs aligned equal chunks.
+        use_flash = impl == "flash"
+        if use_flash and (sq != sk or sq % 8):
+            raise ValueError(f"impl='flash' needs equal tile-aligned "
+                             f"chunks, got ({sq},{sk})")
+    else:
+        raise ValueError(f"unknown impl: {impl!r}")
     if n == 1:
+        if use_flash:
+            return flash_attention(q, k, v, causal=causal)
         return mha_reference(q, k, v, causal=causal)
-    body = functools.partial(_ring_body, axis=axis, n=n, causal=causal)
+    body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
+                             use_flash=use_flash)
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=(spec, P(bspec, None, axis)),
+        out_specs=(spec, P(bspec, hspec, axis)),
         check_vma=False,
     )(q, k, v)
 

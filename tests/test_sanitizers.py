@@ -1,0 +1,109 @@
+"""Sanitizer builds of the native core (SURVEY §5: the reference has no
+TSan/ASan mode anywhere; the shared_mutex-heavy store + serving threads +
+worker pool are exactly the code that needs them).
+
+The sanitized .so cannot be dlopen'd into a stock python (static TLS
+exhaustion for TSan), so each test re-runs a concurrency stress scenario
+in a subprocess with the sanitizer runtime LD_PRELOADed and fails on any
+sanitizer report."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+# The stress scenario: every rank hammers adds/gets/batched gets/epochs
+# concurrently through the threaded in-process group, then a TCP pair
+# exercises the serving threads, pooled ReadVMulti, and the dissemination
+# barrier.
+_STRESS = r"""
+import numpy as np
+import threading
+import uuid
+
+from ddstore_tpu import DDStore, ThreadGroup
+
+WORLD, NUM, DIM = 4, 64, 8
+NAME = uuid.uuid4().hex
+
+def worker(rank, errs):
+    try:
+        group = ThreadGroup(NAME, rank, WORLD)
+        with DDStore(group, backend="local") as s:
+            s.add("v", np.full((NUM, DIM), rank + 1, np.float32))
+            rng = np.random.default_rng(rank)
+            for _ in range(5):
+                s.epoch_begin()
+                idx = rng.integers(0, WORLD * NUM, size=128)
+                batch = s.get_batch("v", idx)
+                assert (batch.mean(axis=1) == (idx // NUM + 1)).all()
+                s.epoch_end()
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=worker, args=(r, errs))
+      for r in range(WORLD)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
+
+# TCP pair in-process: serving threads, pooled ReadVMulti (striped large
+# reads), and the dissemination barrier — the thread-heavy native paths.
+TCPNAME = uuid.uuid4().hex
+BIG = 3 * (1 << 20)  # > 2*kStripeBytes/row so striping kicks in
+
+def tcp_worker(rank, errs):
+    try:
+        group = ThreadGroup(TCPNAME, rank, 2)
+        with DDStore(group, backend="tcp") as s:
+            s.add("v", np.full((4, BIG // 8), rank + 1, np.float64))
+            s.barrier()
+            peer = 1 - rank
+            got = s.get("v", peer * 4, 4)
+            assert (got == peer + 1).all()
+            for _ in range(3):
+                s.barrier()
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=tcp_worker, args=(r, errs)) for r in range(2)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
+print("stress ok")
+"""
+
+
+def _sanitizer_lib(mode):
+    name = {"thread": "libtsan.so", "address": "libasan.so"}[mode]
+    out = subprocess.run(["g++", f"-print-file-name={name}"],
+                         capture_output=True, text=True)
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) else None
+
+
+@pytest.mark.parametrize("mode", ["thread", "address"])
+def test_native_stress_under_sanitizer(mode, tmp_path):
+    lib = _sanitizer_lib(mode)
+    if lib is None:
+        pytest.skip(f"{mode} sanitizer runtime not installed")
+    env = dict(os.environ)
+    env["DDSTORE_SANITIZE"] = mode
+    env["LD_PRELOAD"] = lib
+    # Python itself leaks by design; only the native library's races and
+    # memory errors are interesting. halt_on_error makes any report fatal.
+    env["TSAN_OPTIONS"] = "exitcode=66 halt_on_error=1"
+    env["ASAN_OPTIONS"] = ("detect_leaks=0 exitcode=66 "
+                           "allocator_may_return_null=1")
+    proc = subprocess.run([sys.executable, "-c", _STRESS],
+                          capture_output=True, text=True, env=env,
+                          timeout=600, cwd=os.path.dirname(
+                              os.path.dirname(os.path.abspath(__file__))))
+    report = proc.stdout + proc.stderr
+    assert proc.returncode == 0, report[-4000:]
+    assert "WARNING: ThreadSanitizer" not in report, report[-4000:]
+    assert "ERROR: AddressSanitizer" not in report, report[-4000:]
+    assert "stress ok" in report
