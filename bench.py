@@ -1,28 +1,62 @@
 """Benchmark harness. Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}
 
-Headline metric: store-fed samples/sec/chip into the DP VAE train step
-(BASELINE.json: "samples/sec/chip fed to DDP"), measured at steady state on
-the available accelerator. ``vs_baseline`` is input-pipeline efficiency
-relative to the 0.95 north-star target (the reference publishes no numbers
-of its own — BASELINE.md).
+Headline metric: LM training MFU on the available accelerator (the
+long-context flagship; VERDICT round-1 #1). ``vs_baseline`` compares the
+flash-attention step time against the same step with XLA attention —
+values > 1 mean the Pallas kernel beats the compiler. ``extras`` carries
+the full measurement set:
 
-Also measured (reported on stderr for humans): remote-get p50 latency and
-batched-read bandwidth on a 4-rank store with the reference microbenchmark's
-knobs (rows/rank × row width × random reads, test/demo.py:15-23).
+* ``lm_tokens_per_sec_per_chip``, ``lm_mfu``, ``flash_vs_xla_speedup`` —
+  TransformerLM fwd+bwd step (bf16, causal flash attention).
+* ``vae_samples_per_sec_per_chip``, ``input_pipeline_eff`` — the round-1
+  headline (store-fed DP VAE; BASELINE.json's ">= 0.95 efficiency").
+* ``local_get_p50_us``, ``local_batch_gbps`` — in-process store reads.
+* ``tcp_get_p50_us``, ``tcp_stripe_gbps_1conn``, ``tcp_stripe_gbps``,
+  ``tcp_fence_p50_us``, ``tcp_vae_eff`` — the DCN path over real
+  processes + sockets (VERDICT round-1 weak #1: the round-1 bench never
+  touched the transport): remote single-get p50, striped ReadV bandwidth
+  at 1 vs DDSTORE_CONNS_PER_PEER connections, dissemination-fence
+  latency, and a store-fed VAE epoch whose fetches ride TCP.
+
+Timing on the tunneled TPU runtime cannot trust ``block_until_ready``
+(it returns before device completion); every device measurement uses the
+marginal method — the same jitted ``lax.fori_loop`` at two iteration
+counts, fetching a scalar to force completion, with the difference
+dividing out dispatch/fetch overhead.
 """
 
 import json
+import multiprocessing as mp
 import os
 import sys
+import tempfile
 import time
 
 
+def _marginal_time(make_loop, lo, hi, reps=3):
+    """Best-of-reps wall time of loop(hi) minus loop(lo), per iteration."""
+    times = []
+    for iters in (lo, hi):
+        loop = make_loop(iters)
+        loop()  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            loop()
+            best = min(best, time.perf_counter() - t0)
+        times.append(best)
+    return max(times[1] - times[0], 1e-9) / (hi - lo)
+
+
+# ---------------------------------------------------------------------------
+# Store microbenchmarks (reference harness knobs: rows/rank x row width x
+# random reads, /root/reference/test/demo.py:15-23).
+# ---------------------------------------------------------------------------
+
+
 def store_microbench(world=4, num=65536, dim=64, nbatch=256, batch=256):
-    """demo.py-equivalent harness: rank-stamped shards, random global reads.
-    Returns (p50_single_get_s, batched_GBps). Threaded ranks, in-process
-    transport on rank 0's thread measuring; TCP measured separately in
-    tests to keep bench fast."""
+    """In-process (ThreadGroup) store: single-get p50 + batched GB/s."""
     import threading
     import uuid
 
@@ -47,14 +81,12 @@ def store_microbench(world=4, num=65536, dim=64, nbatch=256, batch=256):
                     s.get("bench", idx)
                     lat.append(time.perf_counter() - t0)
                 lat.sort()
-                p50 = lat[len(lat) // 2]
+                out["p50"] = lat[len(lat) // 2]
                 idxs = rng.integers(0, world * num, size=batch * 64)
                 t0 = time.perf_counter()
                 s.get_batch("bench", idxs)
                 dt = time.perf_counter() - t0
-                gbps = idxs.size * dim * 8 / dt / 1e9
-                out["p50"] = p50
-                out["gbps"] = gbps
+                out["gbps"] = idxs.size * dim * 8 / dt / 1e9
             s.barrier()
 
     ts = [threading.Thread(target=body, args=(r,)) for r in range(world)]
@@ -63,6 +95,275 @@ def store_microbench(world=4, num=65536, dim=64, nbatch=256, batch=256):
     for t in ts:
         t.join(180)
     return out.get("p50", 0.0), out.get("gbps", 0.0)
+
+
+def _tcp_worker(rank, world, rdv, outfile, num, dim):
+    """One bench rank over the real TCP transport (sockets + serving
+    threads + worker pool). Rank 0 measures; all ranks serve. Only rank 0
+    touches jax, pinned to CPU: the store/transport numbers are host-side,
+    and a single TPU chip cannot be opened by four processes at once."""
+    try:
+        import numpy as np
+
+        from ddstore_tpu import DDStore, FileGroup
+
+        g = FileGroup(rdv, rank, world)
+        res = {}
+        with DDStore(g, backend="tcp") as s:
+            shard = np.full((num, dim), rank + 1, np.float64)
+            s.add("bench", shard)
+            s.barrier()
+            if rank == 0:
+                rng = np.random.default_rng(0)
+                # Remote single-get p50: indices pinned to remote shards.
+                lat = []
+                for _ in range(200):
+                    idx = int(rng.integers(num, world * num))
+                    t0 = time.perf_counter()
+                    s.get("bench", idx)
+                    lat.append(time.perf_counter() - t0)
+                lat.sort()
+                res["tcp_get_p50_us"] = lat[len(lat) // 2] * 1e6
+                # Striped bandwidth: one big contiguous remote read
+                # (split across DDSTORE_CONNS_PER_PEER connections).
+                nrows = num
+                t0 = time.perf_counter()
+                s.get("bench", num, nrows)  # rank 1's whole shard
+                dt = time.perf_counter() - t0
+                res["tcp_stripe_gbps"] = nrows * dim * 8 / dt / 1e9
+                # Scattered batched reads across every peer.
+                idxs = rng.integers(0, world * num, size=4096)
+                t0 = time.perf_counter()
+                s.get_batch("bench", idxs)
+                dt = time.perf_counter() - t0
+                res["tcp_batch_gbps"] = idxs.size * dim * 8 / dt / 1e9
+            s.barrier()
+            # Fence latency: everyone participates, rank 0 times it.
+            t0 = time.perf_counter()
+            for _ in range(50):
+                s.barrier()
+            if rank == 0:
+                res["tcp_fence_p50_us"] = (time.perf_counter() - t0) \
+                    / 50 * 1e6
+
+            # Store-fed VAE epoch over TCP: rank 0 trains (CPU jax),
+            # fetching from every rank's shard through the transport; the
+            # other ranks register their shard and serve until the
+            # closing barrier (add is collective).
+            vrows = min(num, 8192)
+            vae_shard = np.tile(shard[:vrows, :1], (1, 784)).astype(
+                np.float32)
+            s.add("vae/data", vae_shard)
+            if rank == 0:
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+
+                from ddstore_tpu.data import (DeviceLoader,
+                                              DistributedSampler)
+                from ddstore_tpu.models import vae
+                from ddstore_tpu.parallel import make_mesh
+
+                class _View:
+                    """ShardedDataset-shaped view over the already-added
+                    variable (adding via the adapter would double-add)."""
+
+                    def __init__(self, store):
+                        self.store = store
+
+                    def __len__(self):
+                        return s.total_rows("vae/data")
+
+                    def fetch(self, indices):
+                        idx = np.ascontiguousarray(indices, dtype=np.int64)
+                        return self.store.get_batch("vae/data", idx)
+
+                ds = _View(s)
+                mesh = make_mesh({"dp": 1}, jax.local_devices()[:1])
+                model, state, tx = vae.create_train_state(
+                    jax.random.key(0), mesh=mesh)
+                step = vae.make_train_step(model, tx, mesh=mesh)
+                sampler = DistributedSampler(len(ds), 1, 0, seed=0)
+                sampler.set_epoch(0)
+                loader = DeviceLoader(ds, sampler, batch_size=512,
+                                      mesh=mesh, prefetch=8, workers=4)
+                key = jax.random.key(1)
+                for xb in loader:
+                    key, sub = jax.random.split(key)
+                    state, loss = step(state, xb, sub)
+                jax.block_until_ready(loss)
+                res["tcp_vae_eff"] = \
+                    loader.metrics.summary()["input_pipeline_efficiency"]
+            s.barrier()
+        if rank == 0:
+            with open(outfile, "w") as f:
+                json.dump(res, f)
+    except BaseException:  # noqa: BLE001
+        import traceback
+        with open(outfile + f".err{rank}", "w") as f:
+            f.write(traceback.format_exc())
+
+
+def tcp_microbench(world=4, num=65536, dim=64):
+    """DCN-path numbers over real processes + sockets on localhost (the
+    reference measures its transport the same way, README.md:182-198)."""
+    results = {}
+    for conns, keys in ((1, {"tcp_stripe_gbps": "tcp_stripe_gbps_1conn"}),
+                        (4, None)):
+        rdv = tempfile.mkdtemp()
+        outfile = os.path.join(rdv, "bench_out.json")
+        env_backup = os.environ.get("DDSTORE_CONNS_PER_PEER")
+        os.environ["DDSTORE_CONNS_PER_PEER"] = str(conns)
+        try:
+            ctx = mp.get_context("spawn")
+            procs = [ctx.Process(target=_tcp_worker,
+                                 args=(r, world, rdv, outfile, num, dim))
+                     for r in range(world)]
+            for p in procs:
+                p.start()
+            for p in procs:
+                p.join(timeout=600)
+                if p.is_alive():
+                    p.terminate()
+        finally:
+            if env_backup is None:
+                os.environ.pop("DDSTORE_CONNS_PER_PEER", None)
+            else:
+                os.environ["DDSTORE_CONNS_PER_PEER"] = env_backup
+        if os.path.exists(outfile):
+            with open(outfile) as f:
+                got = json.load(f)
+            if keys:  # keep only renamed keys from the 1-conn pass
+                for src, dst in keys.items():
+                    results[dst] = got[src]
+            else:
+                results.update(got)
+        else:
+            for r in range(world):
+                err = outfile + f".err{r}"
+                if os.path.exists(err):
+                    with open(err) as f:
+                        print(f"# tcp bench rank {r} failed:\n{f.read()}",
+                              file=sys.stderr)
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Device benchmarks (LM + VAE).
+# ---------------------------------------------------------------------------
+
+_PEAK_BF16 = {
+    # chip -> peak bf16 FLOP/s (public spec sheets)
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops():
+    import jax
+
+    if env := os.environ.get("DDSTORE_PEAK_FLOPS"):
+        return float(env)
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    for name, peak in _PEAK_BF16.items():
+        if kind.startswith(name):
+            return peak
+    return 197e12  # conservative default
+
+
+def _lm_flops_per_step(vocab, dim, layers, b, s):
+    """fwd+bwd FLOPs: matmuls (qkv 6Td^2 + proj 2Td^2 + mlp 16Td^2 per
+    layer, head 2TdV) + causal attention (2bs^2 d per layer), bwd = 2x."""
+    t = b * s
+    fwd = layers * (24 * t * dim * dim + 2 * b * s * s * dim) \
+        + 2 * t * dim * vocab
+    return 3 * fwd
+
+
+def lm_bench():
+    """TransformerLM train step: tokens/s/chip, MFU, flash-vs-XLA."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddstore_tpu.models import transformer
+    from ddstore_tpu.ops.attention import flash_attention, mha_reference
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        vocab, dim, heads, layers, b, s = 32768, 1024, 16, 8, 8, 2048
+        lo, hi = 2, 10
+    else:  # smoke-test the harness; numbers are meaningless on CPU
+        vocab, dim, heads, layers, b, s = 256, 64, 4, 2, 2, 128
+        lo, hi = 1, 3
+
+    model = transformer.TransformerLM(vocab=vocab, dim=dim, heads=heads,
+                                      layers=layers,
+                                      compute_dtype=jnp.bfloat16)
+    state, tx = transformer.create_train_state(jax.random.key(0), model)
+    k1, k2 = jax.random.split(jax.random.key(1))
+    tokens = jax.random.randint(k1, (b, s), 0, vocab)
+    targets = jax.random.randint(k2, (b, s), 0, vocab)
+    positions = jnp.tile(jnp.arange(s), (b, 1))
+
+    def step_fn(st, tok, tgt, pos):
+        def lossf(params):
+            return transformer.loss_fn(model.apply(params, tok, pos), tgt)
+
+        loss, grads = jax.value_and_grad(lossf)(st.params)
+        updates, opt_state = tx.update(grads, st.opt_state, st.params)
+        params = __import__("optax").apply_updates(st.params, updates)
+        return transformer.TrainState(params, opt_state, st.step + 1), loss
+
+    def make_loop(iters):
+        @jax.jit
+        def run(st, tok, tgt, pos):
+            def body(i, carry):
+                st, _ = carry
+                return step_fn(st, tok, tgt, pos)
+            return jax.lax.fori_loop(
+                0, iters, body, (st, jnp.zeros((), jnp.float32)))[1]
+
+        def call():
+            loss = run(state, tokens, targets, positions)
+            float(loss)  # forces completion through the tunnel
+
+        return call
+
+    dt = _marginal_time(make_loop, lo, hi)
+    toks = b * s / dt
+    mfu = _lm_flops_per_step(vocab, dim, layers, b, s) / dt / _peak_flops()
+
+    # Flash vs XLA attention: the same fwd+bwd attention workload.
+    ab, ah, asq, ad = (1, heads, 4096, dim // heads) if on_tpu \
+        else (1, 2, 128, 16)
+    q, k, v = (jax.random.normal(kk, (ab, ah, asq, ad), jnp.bfloat16)
+               for kk in jax.random.split(jax.random.key(2), 3))
+
+    def attn_loop(fn):
+        def make(iters):
+            @jax.jit
+            def run(q, k, v):
+                def body(i, q0):
+                    g = jax.grad(lambda qq: (fn(qq, k, v)[0]
+                                             .astype(jnp.float32) ** 2)
+                                 .sum())(q0)
+                    return (q0 + 1e-6 * g).astype(q0.dtype)
+                return jax.lax.fori_loop(0, iters, body, q)
+
+            def call():
+                float(jax.numpy.sum(run(q, k, v)))
+
+            return call
+        return make
+
+    fa = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    xa = lambda q, k, v: mha_reference(q, k, v, causal=True)
+    dtf = _marginal_time(attn_loop(fa), lo, hi)
+    dtx = _marginal_time(attn_loop(xa), lo, hi)
+    return toks, mfu, dtx / dtf
 
 
 def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
@@ -103,7 +404,6 @@ def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
             loader = DeviceLoader(ds, sampler, batch_size=batch, mesh=mesh,
                                   prefetch=16, workers=8)
             t0 = time.perf_counter()
-            nb = 0
             for xb in loader:
                 key, sub = jax.random.split(key)
                 state, loss = step(state, xb, sub)
@@ -121,20 +421,37 @@ def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
 
 
 def main():
+    extras = {}
+
     p50, gbps = store_microbench()
-    print(f"# store microbench: single-get p50={p50 * 1e6:.1f}us "
-          f"batched-read bw={gbps:.2f} GB/s", file=sys.stderr)
+    extras["local_get_p50_us"] = round(p50 * 1e6, 2)
+    extras["local_batch_gbps"] = round(gbps, 2)
+    print(f"# local store: single-get p50={p50 * 1e6:.1f}us "
+          f"batched bw={gbps:.2f} GB/s", file=sys.stderr)
+
+    tcp = tcp_microbench()
+    extras.update({k: round(v, 3) for k, v in tcp.items()})
+    print(f"# tcp store: {tcp}", file=sys.stderr)
 
     sps_chip, eff, n_dev = vae_pipeline_bench()
+    extras["vae_samples_per_sec_per_chip"] = round(sps_chip, 1)
+    extras["input_pipeline_eff"] = round(eff, 3)
     print(f"# vae pipeline: {sps_chip:.0f} samples/s/chip over {n_dev} "
           f"device(s), input-pipeline efficiency {eff:.3f}",
           file=sys.stderr)
 
+    toks, mfu, speedup = lm_bench()
+    extras["lm_tokens_per_sec_per_chip"] = round(toks, 0)
+    extras["flash_vs_xla_speedup"] = round(speedup, 2)
+    print(f"# lm train: {toks:.0f} tokens/s/chip, MFU={mfu:.3f}, "
+          f"flash-vs-xla={speedup:.2f}x", file=sys.stderr)
+
     print(json.dumps({
-        "metric": "vae_store_fed_samples_per_sec_per_chip",
-        "value": round(sps_chip, 1),
-        "unit": "samples/s/chip",
-        "vs_baseline": round(eff / 0.95, 3),
+        "metric": "lm_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(speedup, 3),
+        "extras": extras,
     }))
 
 
