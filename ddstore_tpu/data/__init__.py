@@ -1,13 +1,18 @@
 """Dataset adapters and device-feeding loaders over the store."""
 
-from .dataset import DistributedSampler, ShardedDataset
+from .dataset import DistributedSampler, ShardedDataset, nsplit
+from .formats import (find_mnist, load_mnist, load_qm9_dir,
+                      molecule_to_graph, read_idx, read_xyz, write_idx,
+                      write_xyz)
 from .graphs import (GraphBatch, GraphSample, GraphShardedDataset,
                      pack_graph_batch, synthetic_graphs)
 from .loader import DeviceLoader
 from .ragged import (pack_ragged, pad_ragged, segment_ids_from_lengths,
                      split_ragged)
 
-__all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader",
+__all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader", "nsplit",
            "pad_ragged", "pack_ragged", "split_ragged",
            "segment_ids_from_lengths", "GraphBatch", "GraphSample",
-           "GraphShardedDataset", "pack_graph_batch", "synthetic_graphs"]
+           "GraphShardedDataset", "pack_graph_batch", "synthetic_graphs",
+           "read_idx", "write_idx", "find_mnist", "load_mnist",
+           "read_xyz", "write_xyz", "molecule_to_graph", "load_qm9_dir"]

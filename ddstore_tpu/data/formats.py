@@ -114,19 +114,43 @@ def _parse_float(tok: str) -> float:
     return float(tok.replace("*^", "e"))
 
 
+def _block_start(line: str) -> Optional[int]:
+    """natoms header = a single bare-integer token; anything else (QM9's
+    frequency/SMILES/InChI trailer lines, blank padding) is not one."""
+    toks = line.split()
+    if len(toks) != 1:
+        return None
+    try:
+        return int(toks[0])
+    except ValueError:
+        return None
+
+
 def read_xyz(path: str) -> List[Tuple[List[str], np.ndarray, np.ndarray]]:
     """Parse one xyz file that may hold many molecule blocks. Returns
     [(symbols, coords (n,3) float32, props (P,) float32), ...]; props are
-    the float tokens of the comment line (empty if none parse)."""
+    the float tokens of the comment line (empty if none parse).
+
+    Handles the real QM9 layout (dsgdb9nsd_*.xyz): per-atom Mulliken
+    charge columns are ignored, and the three trailer lines after the atom
+    block (harmonic frequencies, SMILES, InChI) are skipped — a new block
+    only starts at a bare-integer natoms line."""
     mols = []
     with _open(path, "rt") as f:
         lines = [ln.rstrip("\n") for ln in f]
     i = 0
     while i < len(lines):
-        if not lines[i].strip():
-            i += 1
-            continue
-        n = int(lines[i].strip())
+        n = _block_start(lines[i])
+        if n is None:
+            if mols:  # trailer junk between/after blocks
+                i += 1
+                continue
+            if not lines[i].strip():
+                i += 1
+                continue
+            raise ValueError(
+                f"{path}: expected natoms header at line {i + 1}, got "
+                f"{lines[i]!r}")
         comment = lines[i + 1] if i + 1 < len(lines) else ""
         props = []
         for tok in comment.replace("\t", " ").split():

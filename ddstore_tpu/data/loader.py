@@ -19,6 +19,7 @@ called out in §7 as the anti-pattern to fix). Here the loader:
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -55,13 +56,22 @@ class DeviceLoader:
         with each other — needed to keep small/fast models fed (ctypes
         releases the GIL during store reads, and staging is mostly
         off-GIL transfer work, so threads genuinely parallelize).
-        CONTRACT: with workers > 1, ``dataset.fetch`` and ``transform``
-        are called concurrently and must be thread-safe (store reads and
-        the bundled datasets are; a stateful transform — e.g. one sharing
-        a np.random.Generator — is not: pass workers=1 for those).
+        Default (None): 2 for store-backed datasets (whose ``fetch`` is
+        thread-safe by construction), 1 for a bare callable unless it
+        declares ``thread_safe = True``. Passing an explicit ``workers``
+        value is the caller's declaration that ``dataset.fetch`` is safe
+        at that concurrency.
     drop_last: drop the trailing partial batch (keeps shapes static for
         jit — recompile-free epochs).
     transform: optional host-side function applied to each fetched batch.
+        With workers > 1 the transform is serialized under a lock (fetch
+        and staging still run in parallel), so stateful transforms — e.g.
+        one sharing a np.random.Generator — are race-free by default.
+        Note the lock guarantees exclusion, not order: workers reach the
+        transform in fetch-completion order, so a shared RNG is consumed
+        in a run-dependent sequence — for bit-deterministic augmentation
+        pass workers=1. Mark the transform ``thread_safe = True`` (or
+        pass ``transform_thread_safe=True``) to let it run concurrently.
     """
 
     def __init__(self, dataset, sampler: Iterable[int], batch_size: int,
@@ -69,16 +79,31 @@ class DeviceLoader:
                  prefetch: int = 4, drop_last: bool = True,
                  transform: Optional[Callable] = None,
                  spec: Optional["PartitionSpec"] = None,
-                 workers: int = 2):
+                 workers: Optional[int] = None,
+                 transform_thread_safe: bool = False):
         self.dataset = dataset
         self.sampler = sampler
         self.batch_size = int(batch_size)
         self.mesh = mesh
         self.axis = axis
         self.prefetch = max(1, int(prefetch))
+        if workers is None:
+            # Store-backed datasets expose fetch() whose reads go through
+            # the native core (thread-safe by construction), so objects
+            # default to 2 workers — but an explicit thread_safe attribute
+            # on the dataset wins in either direction; bare callables
+            # default to a single worker unless they opt in.
+            fetch_safe = getattr(dataset, "thread_safe",
+                                 not callable(dataset))
+            workers = 2 if fetch_safe else 1
         self.workers = max(1, int(workers))
         self.drop_last = drop_last
         self.transform = transform
+        self._transform_lock = None
+        if (transform is not None and self.workers > 1
+                and not transform_thread_safe
+                and not getattr(transform, "thread_safe", False)):
+            self._transform_lock = threading.Lock()
         self.metrics = PipelineMetrics()
         if mesh is not None and jax is None:  # pragma: no cover
             raise RuntimeError("jax unavailable but mesh given")
@@ -107,7 +132,11 @@ class DeviceLoader:
             batch = (self.dataset(idx) if callable(self.dataset)
                      else self.dataset.fetch(idx))
         if self.transform is not None:
-            batch = self.transform(batch)
+            if self._transform_lock is not None:
+                with self._transform_lock:
+                    batch = self.transform(batch)
+            else:
+                batch = self.transform(batch)
         if self._sharding is None:
             return batch
         with self.metrics.stage.timed():

@@ -17,8 +17,11 @@ Run 4 host processes on localhost (store goes over TCP):
         DDSTORE_RDV_DIR=/tmp/vae_rdv JAX_PLATFORMS=cpu \
         python examples/vae_mnist.py --epochs 1 & done; wait
 
-Uses a synthetic MNIST-shaped dataset (this environment has no network
-access; swap in real MNIST arrays freely — the pipeline is identical).
+Trains on real MNIST idx files when ``--data-dir`` points at the canonical
+``train-images-idx3-ubyte``/``train-labels-idx1-ubyte`` pair (plain or
+.gz — parity with the reference's torchvision MNIST pipeline,
+vae-ddp.py:202-216); otherwise falls back to a synthetic MNIST-shaped
+dataset (this environment has no network access).
 """
 
 import argparse
@@ -46,13 +49,18 @@ def main():
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=128,
                    help="global batch size")
-    p.add_argument("--samples", type=int, default=4096)
+    p.add_argument("--samples", type=int, default=None,
+                   help="dataset size cap (default: 4096 synthetic "
+                        "samples; the full file with --data-dir)")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--width", type=int, default=None,
                    help="replica-group width (ranks per store group)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--steps", type=int, default=None,
                    help="cap steps per epoch (smoke runs)")
+    p.add_argument("--data-dir", type=str, default=None,
+                   help="directory with MNIST idx files (plain or .gz); "
+                        "omit for synthetic data")
     args = p.parse_args()
 
     import jax
@@ -73,7 +81,15 @@ def main():
 
     group = auto_group()
     store = DDStore(group, width=args.width)
-    data, _labels = synthetic_mnist(args.samples, args.seed)
+    if args.data_dir is not None:
+        from ddstore_tpu.data import load_mnist
+        data, _labels = load_mnist(args.data_dir, split="train")
+        if args.samples is not None and args.samples < len(data):
+            print(f"capping dataset: {args.samples} of {len(data)} samples",
+                  flush=True)
+            data, _labels = data[: args.samples], _labels[: args.samples]
+    else:
+        data, _labels = synthetic_mnist(args.samples or 4096, args.seed)
     # The VAE objective never reads labels; registering only the data
     # variable halves the hot-path read volume.
     ds = ShardedDataset(store, data)

@@ -131,6 +131,66 @@ class TestDeviceLoaderHost:
             with pytest.raises(DDStoreError):
                 list(loader)
 
+    def test_worker_defaults(self):
+        # Store-backed datasets get parallel fetch; a bare callable is
+        # serialized unless it opts in (ADVICE r1 #3 / VERDICT r2 weak #6).
+        with DDStore(SingleGroup(), backend="local") as store:
+            _, _, loader = self._make(store, batch_size=16)
+            assert loader.workers == 2
+        unsafe = lambda idx: np.zeros((len(idx), 2), np.float32)
+        assert DeviceLoader(unsafe, [0, 1], batch_size=1).workers == 1
+        safe = lambda idx: np.zeros((len(idx), 2), np.float32)
+        safe.thread_safe = True
+        assert DeviceLoader(safe, [0, 1], batch_size=1).workers == 2
+
+        # A non-callable dataset declaring itself unsafe wins too.
+        class Unsafe:
+            thread_safe = False
+
+            def fetch(self, idx):
+                return np.zeros((len(idx), 2), np.float32)
+
+            def __len__(self):
+                return 2
+
+        assert DeviceLoader(Unsafe(), [0, 1], batch_size=1).workers == 1
+        # An explicit value is an explicit declaration either way.
+        assert DeviceLoader(unsafe, [0, 1], batch_size=1,
+                            workers=3).workers == 3
+
+    def test_stateful_transform_serialized(self):
+        # A non-reentrant transform must never be entered concurrently
+        # even with workers > 1 (transforms are serialized by default).
+        import threading
+        import time as _time
+
+        busy = threading.Event()
+        calls = []
+
+        def transform(batch):
+            assert not busy.is_set(), "transform entered concurrently"
+            busy.set()
+            _time.sleep(0.005)
+            calls.append(len(batch[0]))
+            busy.clear()
+            return batch
+
+        with DDStore(SingleGroup(), backend="local") as store:
+            _, _, loader = self._make(store, batch_size=8,
+                                      transform=transform, workers=4,
+                                      prefetch=8)
+            n = sum(1 for _ in loader)
+            assert n == 8 and len(calls) == 8
+            assert loader._transform_lock is not None
+
+    def test_threadsafe_transform_not_locked(self):
+        t = lambda b: b
+        t.thread_safe = True
+        with DDStore(SingleGroup(), backend="local") as store:
+            _, _, loader = self._make(store, batch_size=8, transform=t,
+                                      workers=4)
+            assert loader._transform_lock is None
+
     def test_metrics_populated(self):
         with DDStore(SingleGroup(), backend="local") as store:
             _, _, loader = self._make(store, batch_size=16)
