@@ -208,7 +208,8 @@ def tcp_microbench(world=4, num=65536, dim=64):
     """DCN-path numbers over real processes + sockets on localhost (the
     reference measures its transport the same way, README.md:182-198)."""
     results = {}
-    for conns, keys in ((1, {"tcp_stripe_gbps": "tcp_stripe_gbps_1conn"}),
+    for conns, keys in ((1, {"tcp_stripe_gbps": "tcp_stripe_gbps_1conn",
+                             "tcp_batch_gbps": "tcp_batch_gbps_1conn"}),
                         (4, None)):
         rdv = tempfile.mkdtemp()
         outfile = os.path.join(rdv, "bench_out.json")

@@ -258,14 +258,20 @@ char* Store::LocalBase(const std::string& name) const {
   return it == vars_.end() ? nullptr : it->second.base;
 }
 
+// `nbytes > sb - offset` with offset <= sb established first, NOT
+// `offset + nbytes > sb`: the sum wraps on near-INT64_MAX values from a
+// corrupt wire frame and would pass the bound.
+static inline bool RangeBad(int64_t offset, int64_t nbytes, int64_t sb) {
+  return offset < 0 || nbytes < 0 || offset > sb || nbytes > sb - offset;
+}
+
 int Store::ReadLocal(const std::string& name, int64_t offset,
                      int64_t nbytes, void* dst) const {
   std::shared_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
   const VarInfo& v = it->second;
-  if (offset < 0 || nbytes < 0 || offset + nbytes > v.shard_bytes())
-    return kErrOutOfRange;
+  if (RangeBad(offset, nbytes, v.shard_bytes())) return kErrOutOfRange;
   std::memcpy(dst, v.base + offset, nbytes);
   return kOk;
 }
@@ -279,8 +285,7 @@ int Store::ReadLocalV(const std::string& name, const ReadOp* ops,
   const int64_t sb = v.shard_bytes();
   for (int64_t i = 0; i < n; ++i) {
     const ReadOp& op = ops[i];
-    if (op.offset < 0 || op.nbytes < 0 || op.offset + op.nbytes > sb)
-      return kErrOutOfRange;
+    if (RangeBad(op.offset, op.nbytes, sb)) return kErrOutOfRange;
     std::memcpy(op.dst, v.base + op.offset, op.nbytes);
   }
   return kOk;
@@ -292,8 +297,7 @@ int Store::CheckLocal(const std::string& name, int64_t offset,
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
   const VarInfo& v = it->second;
-  if (offset < 0 || nbytes < 0 || offset + nbytes > v.shard_bytes())
-    return kErrOutOfRange;
+  if (RangeBad(offset, nbytes, v.shard_bytes())) return kErrOutOfRange;
   return kOk;
 }
 

@@ -11,7 +11,10 @@
 // re-registers an MR on every read and leaks it), no spin-polling
 // (common.cxx:359-373), no fixed 80K-rank static peer tables (common.h:11),
 // and requests to one peer are pipelined instead of one blocking op at a
-// time.
+// time. Scattered many-row reads are framed into vectored requests (one
+// op-list frame -> one concatenated response scatter-received straight
+// into the destination buffers), so a random-permutation batch costs
+// syscalls per frame, not per row.
 
 #ifndef DDSTORE_TPU_TCP_TRANSPORT_H_
 #define DDSTORE_TPU_TCP_TRANSPORT_H_

@@ -150,9 +150,54 @@ def _worker_scale(rank, world, tmp, q):
         q.put((rank, traceback.format_exc()))
 
 
+def _worker_vec_frames(rank, world, tmp, q, conns):
+    """Crosses every vectored-read framing boundary: >1024 ops per peer
+    (op-count cap), per-frame byte cap, and a single op bigger than the
+    byte cap (scalar fallback), all under the rank-stamp oracle."""
+    try:
+        os.environ["DDSTORE_CONNS_PER_PEER"] = str(conns)
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            # tiny rows: op-count-cap crossing (1500 ops/peer -> 2 frames)
+            tiny_n = 2048
+            s.add("tiny", np.full((tiny_n, 4), rank + 1, np.float64))
+            # fat rows: byte-cap crossing (256 KiB rows; ~30 ops/peer
+            # -> ~7.5 MiB -> 2+ frames; also trips the striping path)
+            fat_n, fat_dim = 24, 32768
+            s.add("fat", np.full((fat_n, fat_dim), rank + 1, np.float64))
+
+            rng = np.random.default_rng(rank)
+            idx = rng.integers(0, world * tiny_n, size=3000)
+            batch = s.get_batch("tiny", idx)
+            np.testing.assert_array_equal(
+                batch.mean(axis=1), (idx // tiny_n + 1).astype(np.float64))
+
+            idx = rng.integers(0, world * fat_n, size=60)
+            batch = s.get_batch("fat", idx)
+            np.testing.assert_array_equal(
+                batch.mean(axis=1), (idx // fat_n + 1).astype(np.float64))
+
+            # One contiguous 5 MiB op (> per-frame byte cap).
+            peer = (rank + 1) % world
+            rows = s.get("fat", peer * fat_n + 2, 20)
+            assert rows.shape == (20, fat_dim) and (rows == peer + 1).all()
+            s.barrier()
+        q.put((rank, None))
+    except BaseException:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
 @pytest.mark.parametrize("world", [2, 4])
 def test_tcp_rank_stamp(world, tmp_path):
     _spawn(world, _worker_rank_stamp, str(tmp_path))
+
+
+@pytest.mark.parametrize("conns", [1, 2])
+def test_tcp_vectored_frames(conns, tmp_path):
+    _spawn(3, _worker_vec_frames, str(tmp_path), extra=(conns,))
 
 
 def test_tcp_world16_scale(tmp_path):
