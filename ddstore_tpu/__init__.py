@@ -22,8 +22,9 @@ Layers (bottom-up):
 """
 
 from .binding import DDStoreError, NativeStore, owner_of
-from .rendezvous import (FileGroup, JaxGroup, ProcessGroup, SingleGroup,
-                         ThreadGroup, auto_group)
+from .rendezvous import (FileGroup, JaxGroup, PodConfig, ProcessGroup,
+                         SingleGroup, ThreadGroup, auto_group,
+                         detect_pod_env, parse_nodelist, pod_bootstrap)
 from .store import DDStore
 
 __version__ = "0.1.0"
@@ -39,5 +40,9 @@ __all__ = [
     "FileGroup",
     "JaxGroup",
     "auto_group",
+    "PodConfig",
+    "detect_pod_env",
+    "parse_nodelist",
+    "pod_bootstrap",
     "__version__",
 ]

@@ -66,6 +66,11 @@ def _load() -> ctypes.CDLL:
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
     lib.dds_set_epoch_collective.restype = ctypes.c_int
     lib.dds_set_epoch_collective.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dds_set_ifaces.restype = ctypes.c_int
+    lib.dds_set_ifaces.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dds_rebind.restype = ctypes.c_int
+    lib.dds_rebind.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_void_p]
     lib.dds_free_var.restype = ctypes.c_int
     lib.dds_free_var.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.dds_barrier.restype = ctypes.c_int
@@ -143,10 +148,18 @@ class NativeStore:
         return self._lib.dds_server_port(self._h)
 
     def set_peers(self, hosts: Sequence[str], ports: Sequence[int]) -> None:
+        """Each host entry may be a comma-separated per-NIC address list;
+        the peer's connection pool spreads round-robin across them."""
         n = len(hosts)
         harr = (ctypes.c_char_p * n)(*[h.encode() for h in hosts])
         parr = (ctypes.c_int * n)(*ports)
         _check(self._lib.dds_set_peers(self._h, harr, parr, n), "set_peers")
+
+    def set_ifaces(self, addrs: Sequence[str]) -> None:
+        """Local per-NIC source addresses; outgoing pool connections bind
+        to them round-robin (multi-NIC striping, DDSTORE_IFACES)."""
+        _check(self._lib.dds_set_ifaces(
+            self._h, ",".join(addrs).encode()), "set_ifaces")
 
     # -- data plane --------------------------------------------------------
 
@@ -210,6 +223,17 @@ class NativeStore:
 
     def set_epoch_collective(self, collective: bool) -> None:
         _check(self._lib.dds_set_epoch_collective(self._h, int(collective)))
+
+    def rebind(self, name: str, arr: np.ndarray) -> None:
+        """Atomically swap the local shard's backing memory to ``arr``
+        (same length, identical contents — e.g. a fresh mmap of the
+        just-spilled shard). The store borrows the buffer; the caller
+        keeps it alive. Concurrent readers see old or new bytes, never a
+        missing variable."""
+        assert arr.flags["C_CONTIGUOUS"]
+        _check(self._lib.dds_rebind(self._h, name.encode(),
+                                    arr.ctypes.data if arr.size else None),
+               f"rebind({name})")
 
     def free_var(self, name: str) -> None:
         _check(self._lib.dds_free_var(self._h, name.encode()),

@@ -113,6 +113,16 @@ int dds_set_epoch_collective(dds_handle* h, int collective) {
   return dds::kOk;
 }
 
+int dds_set_ifaces(dds_handle* h, const char* csv) {
+  if (!h || !h->tcp || !csv) return dds::kErrInvalidArg;
+  h->tcp->SetLocalIfaces(dds::SplitCsv(csv));
+  return dds::kOk;
+}
+
+int dds_rebind(dds_handle* h, const char* name, void* base) {
+  return h ? h->store->Rebind(name, base) : dds::kErrInvalidArg;
+}
+
 int dds_free_var(dds_handle* h, const char* name) {
   return h ? h->store->FreeVar(name) : dds::kErrInvalidArg;
 }

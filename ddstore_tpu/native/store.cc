@@ -230,6 +230,18 @@ int Store::EpochEnd() {
   return kOk;
 }
 
+int Store::Rebind(const std::string& name, void* base) {
+  std::unique_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  VarInfo& v = it->second;
+  if (!base && v.shard_bytes() > 0) return kErrInvalidArg;
+  if (v.owned) ::free(v.base);
+  v.base = static_cast<char*>(base);
+  v.owned = false;
+  return kOk;
+}
+
 int Store::FreeVar(const std::string& name) {
   std::unique_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);

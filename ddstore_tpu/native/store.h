@@ -186,6 +186,16 @@ class Store {
   int EpochEnd();
   void set_epoch_collective(bool collective) { epoch_collective_ = collective; }
 
+  // Atomically swap the LOCAL shard's backing memory to `base` (same byte
+  // length, already holding identical contents), freeing the old buffer if
+  // the store owned it. Runs under the exclusive lock, so concurrent
+  // readers and serving threads see either the old or the new backing,
+  // never a gap — this is how spill_to_disk moves a shard RAM->mmap while
+  // remote readers stay live (the free+re-add alternative has a window
+  // where remote reads return kErrNotFound). The new backing is borrowed:
+  // the caller keeps it alive for the variable's lifetime.
+  int Rebind(const std::string& name, void* base);
+
   // Drop one variable (MPI_Win_free analogue, src/ddstore.cxx:79-96).
   int FreeVar(const std::string& name);
   // Drop everything.

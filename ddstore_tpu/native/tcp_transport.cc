@@ -231,9 +231,17 @@ TcpTransport::TcpTransport(int rank, int world, int port)
   peers_.resize(world_);
   for (int i = 0; i < world_; ++i) {
     peers_[i] = std::make_unique<Peer>();
-    for (long c = 0; c < nconn; ++c)
-      peers_[i]->conns.push_back(std::make_unique<Conn>());
+    for (long c = 0; c < nconn; ++c) {
+      auto conn = std::make_unique<Conn>();
+      conn->idx = static_cast<int>(c);
+      peers_[i]->conns.push_back(std::move(conn));
+    }
   }
+  // C++-only users can set DDSTORE_IFACES (comma-separated local
+  // addresses) directly; the Python layer resolves interface names and
+  // calls SetLocalIfaces with addresses instead.
+  if (const char* env = ::getenv("DDSTORE_IFACES"))
+    local_addrs_ = SplitCsv(env);
 }
 
 TcpTransport::~TcpTransport() {
@@ -269,7 +277,8 @@ int TcpTransport::SetPeers(const std::vector<std::string>& hosts,
       static_cast<int>(ports.size()) != world_)
     return kErrInvalidArg;
   for (int i = 0; i < world_; ++i) {
-    peers_[i]->host = hosts[i];
+    peers_[i]->hosts = SplitCsv(hosts[i]);
+    if (peers_[i]->hosts.empty()) return kErrInvalidArg;
     peers_[i]->port = ports[i];
   }
   return kOk;
@@ -409,7 +418,12 @@ void TcpTransport::HandleConnection(int fd) {
 
 int TcpTransport::EnsureConnected(Peer& p, Conn& c) {
   if (c.fd >= 0) return kOk;
-  if (p.port < 0) return kErrTransport;
+  if (p.port < 0 || p.hosts.empty()) return kErrTransport;
+
+  // Pool member i talks to the peer's i-th advertised NIC address and
+  // binds its local end to our i-th NIC (both round-robin), so striped
+  // reads spread over every DCN interface pair instead of one.
+  const std::string& host = p.hosts[c.idx % p.hosts.size()];
 
   addrinfo hints;
   std::memset(&hints, 0, sizeof(hints));
@@ -418,7 +432,7 @@ int TcpTransport::EnsureConnected(Peer& p, Conn& c) {
   addrinfo* res = nullptr;
   char portstr[16];
   std::snprintf(portstr, sizeof(portstr), "%d", p.port);
-  if (::getaddrinfo(p.host.c_str(), portstr, &hints, &res) != 0 || !res)
+  if (::getaddrinfo(host.c_str(), portstr, &hints, &res) != 0 || !res)
     return kErrTransport;
 
   int fd = -1;
@@ -435,6 +449,25 @@ int TcpTransport::EnsureConnected(Peer& p, Conn& c) {
     fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
     if (fd < 0) continue;
     SetBufSizes(fd);  // must precede connect() for window scaling
+    if (!local_addrs_.empty()) {
+      const std::string& src =
+          local_addrs_[static_cast<size_t>(c.idx) % local_addrs_.size()];
+      sockaddr_in la;
+      std::memset(&la, 0, sizeof(la));
+      la.sin_family = AF_INET;
+      if (::inet_pton(AF_INET, src.c_str(), &la.sin_addr) == 1) {
+        // Best effort: an unbindable source address (NIC down, bad
+        // config) falls back to the kernel's default route rather than
+        // failing the read path.
+        if (::bind(fd, reinterpret_cast<sockaddr*>(&la), sizeof(la)) != 0 &&
+            DebugOn())
+          std::fprintf(stderr, "[dds r%d] bind to iface %s failed: %s\n",
+                       rank_, src.c_str(), std::strerror(errno));
+      } else if (DebugOn()) {
+        std::fprintf(stderr, "[dds r%d] bad DDSTORE_IFACES entry %s\n",
+                     rank_, src.c_str());
+      }
+    }
     while (::connect(fd, ai->ai_addr, ai->ai_addrlen) < 0) {
       if ((errno == ECONNREFUSED || errno == ETIMEDOUT) &&
           std::chrono::steady_clock::now() < deadline &&

@@ -33,6 +33,20 @@
 
 namespace dds {
 
+// Split "a,b,c" into non-empty tokens (endpoint/NIC address lists on the
+// wire and in env vars all use this format).
+inline std::vector<std::string> SplitCsv(const std::string& s) {
+  std::vector<std::string> out;
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t next = s.find(',', pos);
+    if (next == std::string::npos) next = s.size();
+    if (next > pos) out.push_back(s.substr(pos, next - pos));
+    pos = next + 1;
+  }
+  return out;
+}
+
 class TcpTransport : public Transport {
  public:
   // Starts the serving thread immediately; binds to `port` (0 = ephemeral).
@@ -47,9 +61,21 @@ class TcpTransport : public Transport {
 
   // Peer endpoint table, from the caller's rendezvous (the reference
   // exchanges endpoints with MPI_Allgather, common.cxx:285-302; here the
-  // Python layer does it). Must be called before any Read/Barrier.
+  // Python layer does it). Must be called before any Read/Barrier. Each
+  // host entry may be a comma-separated address list (one per NIC): the
+  // members of that peer's connection pool are spread round-robin across
+  // the advertised addresses, so striped reads ride every DCN NIC — the
+  // reference can only force ONE fabric interface (FABRIC_IFACE,
+  // common.cxx:32,54-59).
   int SetPeers(const std::vector<std::string>& hosts,
                const std::vector<int>& ports);
+
+  // Local source addresses (one per NIC) to bind outgoing connections to,
+  // round-robin by pool index; empty = kernel default. Mirrors
+  // DDSTORE_IFACES on the receive side of the same NIC-spreading story.
+  void SetLocalIfaces(const std::vector<std::string>& addrs) {
+    local_addrs_ = addrs;
+  }
 
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
@@ -74,10 +100,11 @@ class TcpTransport : public Transport {
   // target, so large reads stripe across streams and server cores.
   struct Conn {
     int fd = -1;
+    int idx = 0;    // position in the pool; picks the NIC pairing
     std::mutex mu;  // serializes use of this connection
   };
   struct Peer {
-    std::string host;
+    std::vector<std::string> hosts;  // one entry per advertised NIC
     int port = -1;
     std::vector<std::unique_ptr<Conn>> conns;
   };
@@ -104,6 +131,7 @@ class TcpTransport : public Transport {
   std::vector<int> conn_fds_;
 
   std::vector<std::unique_ptr<Peer>> peers_;
+  std::vector<std::string> local_addrs_;
 
   // Leaf read tasks (one per peer-connection stripe) run here; threads are
   // created lazily and persist for the transport's lifetime.

@@ -308,6 +308,166 @@ class _SubGroup(ProcessGroup):
                          members)
 
 
+# ---------------------------------------------------------------------------
+# Pod / scheduler bootstrap
+# ---------------------------------------------------------------------------
+#
+# The reference bootstraps torch.distributed from scheduler env — Summit LSB
+# and SLURM node lists (/root/reference/examples/vae/vae-ddp.py:61-145). The
+# TPU-pod equivalent is bringing up `jax.distributed` itself; these helpers
+# detect the same scheduler families plus GCE/GKE TPU-pod metadata env, pick
+# a coordinator deterministically, and hand back a ready ProcessGroup.
+
+
+class PodConfig:
+    """Where this process sits in the pod/job and who coordinates."""
+
+    __slots__ = ("coordinator", "num_processes", "process_id", "source")
+
+    def __init__(self, coordinator: str, num_processes: int,
+                 process_id: int, source: str):
+        self.coordinator = coordinator
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.source = source
+
+    def __repr__(self):  # pragma: no cover
+        return (f"PodConfig({self.coordinator!r}, n={self.num_processes}, "
+                f"id={self.process_id}, via {self.source})")
+
+
+def _expand_item(item: str) -> List[str]:
+    """Expand ONE nodelist item, cross-producting every bracket group and
+    preserving any literal text between/after them: ``"r[0-1]n[01-02]"``
+    -> ``["r0n01", "r0n02", "r1n01", "r1n02"]``; ``"cn[1-2]-ib"`` ->
+    ``["cn1-ib", "cn2-ib"]``."""
+    lb = item.find("[")
+    if lb < 0:
+        return [item] if item else []
+    rb = item.index("]", lb)
+    expansions: List[str] = []
+    for part in item[lb + 1: rb].split(","):
+        if "-" in part:
+            lo, hi = part.split("-")
+            width = len(lo)
+            expansions.extend(f"{v:0{width}d}"
+                              for v in range(int(lo), int(hi) + 1))
+        else:
+            expansions.append(part)
+    tails = _expand_item(item[rb + 1:]) or [""]
+    return [item[:lb] + e + t for e in expansions for t in tails]
+
+
+def parse_nodelist(nodelist: str) -> List[str]:
+    """Expand a SLURM-style compressed node list into hostnames:
+    ``"tpu[001-003,07],login1"`` -> ``["tpu001", "tpu002", "tpu003",
+    "tpu07", "login1"]`` (zero-padding preserved; bracket groups may have
+    suffixes or repeat, e.g. ``"cn[1-2]-ib"``)."""
+    # Split on top-level commas only (commas inside [...] are ranges).
+    items: List[str] = []
+    depth, start = 0, 0
+    for i, ch in enumerate(nodelist):
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            items.append(nodelist[start:i])
+            start = i + 1
+    items.append(nodelist[start:])
+    hosts: List[str] = []
+    for item in items:
+        hosts.extend(_expand_item(item))
+    return hosts
+
+
+def detect_pod_env(env: Optional[Dict[str, str]] = None,
+                   port: int = 8476) -> Optional[PodConfig]:
+    """Inspect the environment for a multi-process launch context.
+
+    Priority: explicit ``DDSTORE_COORDINATOR``/``DDSTORE_NUM_PROCESSES``/
+    ``DDSTORE_PROCESS_ID`` -> GKE/GCE TPU pod metadata (``TPU_WORKER_ID``,
+    ``TPU_WORKER_HOSTNAMES``) -> SLURM (``SLURM_PROCID``/``SLURM_NPROCS``/
+    ``SLURM_NODELIST``, the reference's CADES path, vae-ddp.py:32-35,118)
+    -> LSF/Summit (``LSB_MCPU_HOSTS``ancestry + ``OMPI_COMM_WORLD_*``,
+    vae-ddp.py:28-31,112-117). Returns None when nothing matches (single
+    process)."""
+    e = os.environ if env is None else env
+
+    if "DDSTORE_COORDINATOR" in e:
+        coord = e["DDSTORE_COORDINATOR"]
+        if ":" not in coord:
+            coord = f"{coord}:{port}"
+        return PodConfig(coord, int(e["DDSTORE_NUM_PROCESSES"]),
+                         int(e["DDSTORE_PROCESS_ID"]), "explicit")
+
+    if "TPU_WORKER_HOSTNAMES" in e and "TPU_WORKER_ID" in e:
+        hosts = [h.strip() for h in e["TPU_WORKER_HOSTNAMES"].split(",")
+                 if h.strip()]
+        return PodConfig(f"{hosts[0]}:{port}", len(hosts),
+                         int(e["TPU_WORKER_ID"]), "tpu-pod")
+
+    if "SLURM_PROCID" in e:
+        nproc = int(e.get("SLURM_NPROCS", e.get("SLURM_NTASKS", "1")))
+        hosts = parse_nodelist(e.get("SLURM_NODELIST", ""))
+        if not hosts:
+            return None
+        return PodConfig(f"{hosts[0]}:{port}", nproc,
+                         int(e["SLURM_PROCID"]), "slurm")
+
+    if ("LSB_MCPU_HOSTS" in e and "OMPI_COMM_WORLD_RANK" in e
+            and "OMPI_COMM_WORLD_SIZE" in e):
+        # "host1 ncpu1 host2 ncpu2 ..." — first entry may be a launch node
+        # (the reference drops entry 0, vae-ddp.py:112-117 uses [1]).
+        # A partial LSF env (empty host var, missing size) falls through
+        # to the remaining detectors instead of raising.
+        hosts = e["LSB_MCPU_HOSTS"].split()[0::2]
+        if hosts:
+            coord = hosts[1] if len(hosts) > 1 else hosts[0]
+            return PodConfig(f"{coord}:{port}",
+                             int(e["OMPI_COMM_WORLD_SIZE"]),
+                             int(e["OMPI_COMM_WORLD_RANK"]), "lsf")
+
+    return None
+
+
+def pod_bootstrap(env: Optional[Dict[str, str]] = None, port: int = 8476,
+                  timeout: float = 120.0) -> ProcessGroup:
+    """Bring up ``jax.distributed`` (if a pod/scheduler context is
+    detected) and return the matching ProcessGroup — the one-call
+    production entry point on GCE/GKE TPU pods::
+
+        group = ddstore_tpu.pod_bootstrap()
+        store = ddstore_tpu.DDStore(group, backend="tcp")
+
+    Detection falls back to JAX's own auto-detection
+    (``jax.distributed.initialize()`` with no arguments handles Cloud TPU
+    metadata) and finally to a single-process group. Safe to call when
+    ``jax.distributed`` is already initialized (it is left untouched);
+    a FAILED initialization propagates — a multi-host job must fail
+    loudly, not silently degrade to world-of-1 stores."""
+    import jax
+
+    e = os.environ if env is None else env
+    already_up = jax.distributed.is_initialized() \
+        if hasattr(jax.distributed, "is_initialized") \
+        else jax.process_count() > 1
+    if not already_up:
+        cfg = detect_pod_env(env, port)
+        if cfg is not None:
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+                initialization_timeout=int(timeout))
+        elif e.get("DDSTORE_POD_AUTODETECT") == "1":
+            # On Cloud TPU, no-arg initialize reads the metadata server.
+            jax.distributed.initialize(initialization_timeout=int(timeout))
+    if jax.process_count() > 1:
+        return JaxGroup()
+    return SingleGroup()
+
+
 def auto_group(timeout: float = 120.0) -> ProcessGroup:
     """Pick a group from the environment.
 

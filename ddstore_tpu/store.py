@@ -36,6 +36,36 @@ def _my_host() -> str:
         return "127.0.0.1"
 
 
+def _resolve_iface(token: str) -> str:
+    """An IPv4 address passes through; anything else is treated as an
+    interface name and resolved via SIOCGIFADDR (the reference's
+    FABRIC_IFACE takes a fabric interface name the same way,
+    common.cxx:32,54-59)."""
+    try:
+        socket.inet_aton(token)
+        return token
+    except OSError:
+        pass
+    import fcntl
+    import struct
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        packed = struct.pack("256s", token.encode()[:255])
+        try:
+            addr = fcntl.ioctl(s.fileno(), 0x8915, packed)[20:24]  # SIOCGIFADDR
+        except OSError as e:
+            raise ValueError(f"DDSTORE_IFACES: cannot resolve interface "
+                             f"{token!r}: {e}") from None
+    return socket.inet_ntoa(addr)
+
+
+def _my_ifaces() -> list:
+    """Per-NIC addresses this rank advertises and binds outgoing
+    connections to (DDSTORE_IFACES=addr-or-ifname[,addr-or-ifname...]).
+    Empty list = single-NIC default (_my_host)."""
+    env = os.environ.get("DDSTORE_IFACES", "")
+    return [_resolve_iface(t.strip()) for t in env.split(",") if t.strip()]
+
+
 class _VarMeta:
     __slots__ = ("dtype", "sample_shape", "disp", "all_nrows", "pinned",
                  "readonly")
@@ -111,11 +141,18 @@ class DDStore:
         elif backend == "tcp":
             self._gid = None
             self._native = NativeStore.create_tcp(rank, world, port)
+            # Multi-NIC: advertise every DDSTORE_IFACES address (the
+            # server listens on INADDR_ANY, so one port serves all NICs)
+            # and bind outgoing pool connections to them round-robin.
+            ifaces = _my_ifaces()
+            advertised = ",".join(ifaces) if ifaces else _my_host()
             endpoints = self.group.allgather(
-                (_my_host(), self._native.server_port))
+                (advertised, self._native.server_port))
             hosts = [h for h, _ in endpoints]
             ports = [p for _, p in endpoints]
             self._native.set_peers(hosts, ports)
+            if ifaces:
+                self._native.set_ifaces(ifaces)
         else:
             raise ValueError(f"unknown backend: {backend}")
         self._native.set_epoch_collective(epoch_collective)
@@ -255,17 +292,32 @@ class DDStore:
                       chunk_rows: int = 65536) -> str:
         """Move this variable's local shard from RAM to a file-backed
         mapping (collective: every rank spills its own shard). Remote
-        readers are unaffected — reads are served from page cache. The
-        on-disk artifact is a checkpoint shard (``utils.save_shard``
-        format, JSON sidecar included), so a spilled variable restores
-        across restarts with ``utils.load_shard(..., mmap=True)``."""
+        readers are unaffected: the shard is first written to disk, then
+        the backing memory is swapped to the mmap ATOMICALLY under the
+        native store's exclusive lock (``Rebind``) — a concurrent remote
+        read is served from either the old RAM buffer or the new page
+        cache mapping, both holding identical bytes; there is no window
+        where the variable is missing (the free+re-add alternative had
+        one). The on-disk artifact is a checkpoint shard
+        (``utils.save_shard`` format, JSON sidecar included), so a
+        spilled variable restores across restarts with
+        ``utils.load_shard(..., mmap=True)``."""
         from .utils.checkpoint import save_shard
 
         m = self._require(name)
-        dtype, sample_shape = m.dtype, m.sample_shape
         path = save_shard(self, name, directory, chunk_rows=chunk_rows)
-        self.free(name)
-        self.add_mmap(name, path, dtype, sample_shape)
+        nrows = m.all_nrows[self.rank]
+        if nrows:
+            arr = np.memmap(path, dtype=m.dtype, mode="r",
+                            shape=(nrows,) + tuple(m.sample_shape))
+        else:  # mmap of an empty file is invalid
+            arr = np.empty((0,) + tuple(m.sample_shape), m.dtype)
+        self._native.rebind(name, arr)
+        m.pinned = arr  # keep the mapping alive; old pin (if any) drops
+        m.readonly = True
+        # Collective completion: once any rank returns, every rank's swap
+        # is done (mirrors add()'s barrier guarantee).
+        self.barrier()
         return path
 
     # -- ragged variables --------------------------------------------------
