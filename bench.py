@@ -34,19 +34,32 @@ import tempfile
 import time
 
 
-def _marginal_time(make_loop, lo, hi, reps=3):
-    """Best-of-reps wall time of loop(hi) minus loop(lo), per iteration."""
-    times = []
-    for iters in (lo, hi):
-        loop = make_loop(iters)
+def _marginal_time(make_loop, lo, hi, reps=3, retries=3):
+    """Best-of-reps wall time of loop(hi) minus loop(lo), per iteration.
+
+    Host-side noise (a contended CPU between dispatch and fetch) can make
+    loop(hi) measure FASTER than loop(lo), collapsing the margin to the
+    floor and exploding any ratio built on it; re-measure the pair until
+    the margin is sane instead of reporting a clamped artifact."""
+    loops = [make_loop(lo), make_loop(hi)]
+    for loop in loops:
         loop()  # compile + warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            loop()
-            best = min(best, time.perf_counter() - t0)
-        times.append(best)
-    return max(times[1] - times[0], 1e-9) / (hi - lo)
+    margin = -1.0
+    for _ in range(retries):
+        times = []
+        for loop in loops:
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                loop()
+                best = min(best, time.perf_counter() - t0)
+            times.append(best)
+        margin = max(margin, times[1] - times[0])
+        # Plausible = the extra iterations cost at least ~half their
+        # pro-rata share of the hi run.
+        if margin > 0.5 * times[1] * (hi - lo) / hi:
+            break
+    return max(margin, 1e-9) / (hi - lo)
 
 
 # ---------------------------------------------------------------------------
@@ -284,24 +297,113 @@ def _lm_flops_per_step(vocab, dim, layers, b, s):
     return 3 * fwd
 
 
-def lm_bench():
-    """TransformerLM train step: tokens/s/chip, MFU, flash-vs-XLA."""
+def onchip_attention_check():
+    """Assert flash == reference ON THE CURRENT BACKEND — outputs AND
+    gradients, head_dim 64 and 128, causal plus the ring offset cases,
+    plus the ring lax.cond-of-kernels construct (VERDICT r2 weak #3/#4:
+    everything numeric previously ran only in CPU interpret mode; Mosaic
+    lowering is exactly where interpret-correct kernels go wrong). Raises
+    on any mismatch — the bench must fail loudly, not time wrong code."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddstore_tpu.ops.attention import flash_attention, mha_reference
+
+    on_tpu = jax.default_backend() == "tpu"
+    s = 2048 if on_tpu else 128
+    ncases = 0
+
+    def check(name, got, want):
+        # bf16 inputs/outputs with f32 accumulation: values agree to
+        # ~1e-2, except isolated elements where the two summation orders
+        # round through bf16 differently (single-ulp cancellation). A real
+        # lowering bug mismatches broadly, so: allow <=0.01% of elements
+        # outside the 3e-2 band, and bound the worst deviation hard.
+        g = np.asarray(got, np.float32)
+        w = np.asarray(want, np.float32)
+        bad = ~np.isclose(g, w, atol=3e-2, rtol=3e-2)
+        frac = bad.mean() if bad.size else 0.0
+        worst = float(np.abs(g - w).max()) if g.size else 0.0
+        if frac > 1e-4 or worst > 0.25:
+            raise AssertionError(
+                f"on-chip mismatch: {name}: {frac:.2%} elements outside "
+                f"tolerance, worst |diff|={worst:.4f}")
+
+    for hd in (64, 128):
+        kq, kk, kv = jax.random.split(jax.random.key(hd), 3)
+        q = jax.random.normal(kq, (1, 4, s, hd), jnp.bfloat16)
+        k = jax.random.normal(kk, (1, 4, s, hd), jnp.bfloat16)
+        v = jax.random.normal(kv, (1, 4, s, hd), jnp.bfloat16)
+        # (causal, q_offset, kv_offset): plain, causal/diag, ring "past"
+        # chunk, ring mid-offset diag.
+        for causal, qo, ko in [(False, 0, 0), (True, 0, 0), (True, s, 0),
+                               (True, s // 2, s // 2)]:
+            def lossf(fn):
+                def f(q, k, v):
+                    out, _ = fn(q, k, v, causal=causal, q_offset=qo,
+                                kv_offset=ko)
+                    return (out.astype(jnp.float32) ** 2).sum()
+                return f
+
+            vg_f = jax.jit(jax.value_and_grad(lossf(flash_attention),
+                                              argnums=(0, 1, 2)))
+            vg_r = jax.jit(jax.value_and_grad(lossf(mha_reference),
+                                              argnums=(0, 1, 2)))
+            loss_f, grads_f = vg_f(q, k, v)
+            loss_r, grads_r = vg_r(q, k, v)
+            tag = f"hd{hd} causal={causal} off=({qo},{ko})"
+            # Loss is a sum over b*h*s*hd squared outputs; compare the mean.
+            check(f"{tag} loss", loss_f / q.size, loss_r / q.size)
+            for nm, gf, gr in zip("qkv", grads_f, grads_r):
+                check(f"{tag} d{nm}", gf, gr)
+            ncases += 1
+
+    # The ring three-case construct: lax.cond selecting between
+    # statically-configured Pallas kernels (parallel/ring_attention.py
+    # _ring_body) — compile and run every branch on this backend.
+    q = jax.random.normal(jax.random.key(7), (1, 2, s, 64), jnp.bfloat16)
+
+    @jax.jit
+    def ring_cases(pred_diag, pred_past, q):
+        def diag(args):
+            return flash_attention(*args, causal=True)
+
+        def past(args):
+            return flash_attention(*args, causal=False)
+
+        def masked(args):
+            return (jnp.zeros(q.shape, q.dtype),
+                    jnp.full(q.shape[:3], -jnp.inf, jnp.float32))
+
+        return jax.lax.cond(
+            pred_diag, diag,
+            lambda a: jax.lax.cond(pred_past, past, masked, a), (q, q, q))
+
+    for pd, pp, ref_kw in [(True, False, dict(causal=True)),
+                           (False, True, dict(causal=False)),
+                           (False, False, None)]:
+        out, lse = ring_cases(pd, pp, q)
+        if ref_kw is None:
+            assert not np.asarray(out).any() and \
+                not np.isfinite(np.asarray(lse)).any(), \
+                "ring masked branch produced nonzero output"
+        else:
+            want, _ = jax.jit(lambda q: mha_reference(q, q, q, **ref_kw))(q)
+            check(f"ring-cond {ref_kw}", out, want)
+        ncases += 1
+    return ncases
+
+
+def _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=False):
+    """Seconds per TransformerLM fwd+bwd+update step at the given shape."""
     import jax
     import jax.numpy as jnp
 
     from ddstore_tpu.models import transformer
-    from ddstore_tpu.ops.attention import flash_attention, mha_reference
-
-    on_tpu = jax.default_backend() == "tpu"
-    if on_tpu:
-        vocab, dim, heads, layers, b, s = 32768, 1024, 16, 8, 8, 2048
-        lo, hi = 2, 10
-    else:  # smoke-test the harness; numbers are meaningless on CPU
-        vocab, dim, heads, layers, b, s = 256, 64, 4, 2, 2, 128
-        lo, hi = 1, 3
 
     model = transformer.TransformerLM(vocab=vocab, dim=dim, heads=heads,
-                                      layers=layers,
+                                      layers=layers, remat=remat,
                                       compute_dtype=jnp.bfloat16)
     state, tx = transformer.create_train_state(jax.random.key(0), model)
     k1, k2 = jax.random.split(jax.random.key(1))
@@ -333,7 +435,25 @@ def lm_bench():
 
         return call
 
-    dt = _marginal_time(make_loop, lo, hi)
+    return _marginal_time(make_loop, lo, hi)
+
+
+def lm_bench():
+    """TransformerLM train step: tokens/s/chip, MFU, flash-vs-XLA."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddstore_tpu.ops.attention import flash_attention, mha_reference
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        vocab, dim, heads, layers, b, s = 32768, 1024, 16, 8, 8, 2048
+        lo, hi = 2, 10
+    else:  # smoke-test the harness; numbers are meaningless on CPU
+        vocab, dim, heads, layers, b, s = 256, 64, 4, 2, 2, 128
+        lo, hi = 1, 3
+
+    dt = _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi)
     toks = b * s / dt
     mfu = _lm_flops_per_step(vocab, dim, layers, b, s) / dt / _peak_flops()
 
@@ -365,6 +485,26 @@ def lm_bench():
     dtf = _marginal_time(attn_loop(fa), lo, hi)
     dtx = _marginal_time(attn_loop(xa), lo, hi)
     return toks, mfu, dtx / dtf
+
+
+def lm_long_bench():
+    """Long-context flagship number: S=8192 remat TransformerLM train step
+    (tokens/s/chip + MFU). Same model family as lm_bench, batch traded for
+    sequence; remat keeps activation memory at O(sqrt-ish) so the step
+    fits a single chip at 4x the context."""
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        vocab, dim, heads, layers, b, s = 32768, 1024, 16, 8, 2, 8192
+        lo, hi = 1, 5
+    else:
+        vocab, dim, heads, layers, b, s = 256, 64, 4, 2, 1, 256
+        lo, hi = 1, 2
+    dt = _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=True)
+    toks = b * s / dt
+    mfu = _lm_flops_per_step(vocab, dim, layers, b, s) / dt / _peak_flops()
+    return toks, mfu, s
 
 
 def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
@@ -441,11 +581,23 @@ def main():
           f"device(s), input-pipeline efficiency {eff:.3f}",
           file=sys.stderr)
 
+    ncases = onchip_attention_check()
+    extras["onchip_numerics_cases"] = ncases
+    print(f"# on-chip numerics: flash==reference fwd+grads, {ncases} cases "
+          f"ok", file=sys.stderr)
+
     toks, mfu, speedup = lm_bench()
     extras["lm_tokens_per_sec_per_chip"] = round(toks, 0)
     extras["flash_vs_xla_speedup"] = round(speedup, 2)
     print(f"# lm train: {toks:.0f} tokens/s/chip, MFU={mfu:.3f}, "
           f"flash-vs-xla={speedup:.2f}x", file=sys.stderr)
+
+    ltoks, lmfu, ls = lm_long_bench()
+    extras["lm_long_tokens_per_sec_per_chip"] = round(ltoks, 0)
+    extras["lm_long_mfu"] = round(lmfu, 4)
+    extras["lm_long_seq"] = ls
+    print(f"# lm long-context: S={ls} remat, {ltoks:.0f} tokens/s/chip, "
+          f"MFU={lmfu:.3f}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "lm_train_mfu",

@@ -25,7 +25,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention, mha_reference
-from ..parallel.pipeline import pipeline_apply, stack_stage_params
+from ..parallel.pipeline import (pipeline_1f1b, pipeline_apply,
+                                 stack_stage_params)
 from ..parallel.ring_attention import ring_attention
 from ..parallel.tp import (expert_rules, megatron_rules, shard_pytree,
                            shardings_of)
@@ -327,16 +328,60 @@ def create_pp_train_state(rng: jax.Array, model: TransformerLM,
     return state, tx
 
 
+def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
+                           tokens, targets, positions, *,
+                           n_microbatches: int, mesh: Mesh,
+                           pp_axis: str = "pp",
+                           dp_axis: Optional[str] = None):
+    """Loss + full-model gradients via the fused 1F1B schedule.
+
+    Embedding runs outside the ring under ``jax.vjp`` (its gradient
+    chains through the schedule's input cotangent); the LM head + loss
+    run inside the last stage's schedule slot. This is THE production
+    gradient path of ``make_pp_train_step(schedule="1f1b")`` — exactness
+    tests call it directly so they can't drift from what trains."""
+    outer, stages = pp_params
+
+    def embed_f(embed_params):
+        return _embed_apply(model, {"params": {"embed": embed_params}},
+                            tokens, positions)
+
+    x, embed_vjp = jax.vjp(embed_f, outer["params"]["embed"])
+    b = x.shape[0]
+    mb = b // n_microbatches
+    xm = x.reshape(n_microbatches, mb, *x.shape[1:])
+    tm = targets.reshape(n_microbatches, mb, *targets.shape[1:])
+
+    def head_loss(head_params, y, tgt):
+        logits = LMHead(model.vocab).apply({"params": head_params}, y)
+        return loss_fn(logits, tgt)
+
+    loss, gstages, ghead, dxm = pipeline_1f1b(
+        stage_fn, head_loss, stages, outer["params"]["lmhead"], xm, tm,
+        mesh=mesh, axis=pp_axis, dp_axis=dp_axis)
+    (gembed,) = embed_vjp(dxm.reshape(b, *dxm.shape[2:]))
+    return loss, ({"params": {"embed": gembed, "lmhead": ghead}}, gstages)
+
+
 def make_pp_train_step(model: TransformerLM,
                        tx: optax.GradientTransformation, mesh: Mesh,
                        n_stages: int, n_microbatches: int,
                        pp_axis: str = "pp", dp_axis: str = "dp",
-                       donate: bool = True, remat: bool = False):
+                       donate: bool = True, remat: bool = False,
+                       schedule: str = "gpipe"):
     """Jitted dp×pp train step over ``(tokens, targets, positions)``.
 
     The batch dim must be ``n_microbatches * mb`` with ``mb`` divisible
-    by the dp axis. Embed/head run dp-sharded outside the ring; the
-    block stages stream microbatches through ``pipeline_apply``.
+    by the dp axis. Embed runs dp-sharded outside the ring; the block
+    stages stream microbatches through the chosen ``schedule``:
+
+    * ``"gpipe"`` — :func:`pipeline_apply` under autodiff (head outside
+      the ring); activation live-set grows with n_microbatches unless
+      ``remat``.
+    * ``"1f1b"`` — :func:`pipeline_1f1b`, the fused forward/backward
+      schedule whose stash is bounded by the stage count (O(S) vs O(M));
+      the head + loss run inside the last stage's schedule slot and the
+      embedding gradient chains through the returned input cotangent.
     """
     if model.n_experts > 0:
         # The stage_fn applies blocks without mutable intermediates, so
@@ -346,10 +391,12 @@ def make_pp_train_step(model: TransformerLM,
         raise NotImplementedError(
             "pipeline parallelism does not yet thread the MoE aux loss; "
             "use make_train_step with an ep mesh for MoE models")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule: {schedule!r}")
     stage_fn = _make_stage_fn(model, n_stages)
     dp = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
 
-    def step(state: TrainState, tokens, targets, positions):
+    def grads_gpipe(pp_params, tokens, targets, positions):
         def lossf(pp_params):
             outer, stages = pp_params
             x = _embed_apply(model, outer, tokens, positions)
@@ -362,7 +409,18 @@ def make_pp_train_step(model: TransformerLM,
             logits = _head_apply(model, outer, y)
             return loss_fn(logits, targets)
 
-        loss, grads = jax.value_and_grad(lossf)(state.params)
+        return jax.value_and_grad(lossf)(pp_params)
+
+    def grads_1f1b(pp_params, tokens, targets, positions):
+        return pp_1f1b_value_and_grad(
+            model, stage_fn, pp_params, tokens, targets, positions,
+            n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
+            dp_axis=dp)
+
+    grads_of = grads_gpipe if schedule == "gpipe" else grads_1f1b
+
+    def step(state: TrainState, tokens, targets, positions):
+        loss, grads = grads_of(state.params, tokens, targets, positions)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss

@@ -8,7 +8,8 @@ value-add that connects the host-side store to device meshes.
 
 from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
                    make_mesh, replicate)
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (pipeline_1f1b, pipeline_apply,
+                       stack_stage_params)
 from .ring_attention import ring_attention, ring_self_attention
 from .shuffle import all_to_all_rows, global_shuffle_epoch, permute_rows
 from .tp import expert_rules, megatron_rules, shard_pytree, shardings_of
@@ -29,5 +30,6 @@ __all__ = [
     "shard_pytree",
     "shardings_of",
     "pipeline_apply",
+    "pipeline_1f1b",
     "stack_stage_params",
 ]

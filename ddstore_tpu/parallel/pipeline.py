@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = ["pipeline_apply", "pipeline_1f1b", "stack_stage_params"]
 
 
 def stack_stage_params(per_stage_params):
@@ -101,3 +101,180 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
         out_specs=xspec,
         check_vma=False,
     )(stage_params, x)
+
+
+def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
+                  loss_params, x, aux, *, mesh: Mesh, axis: str = "pp",
+                  dp_axis: Optional[str] = None):
+    """1F1B pipeline schedule: fused forward+backward with O(S) activation
+    stash per device instead of GPipe-autodiff's O(M).
+
+    The GPipe path (:func:`pipeline_apply` under ``jax.value_and_grad``)
+    runs the whole forward schedule, saving every scan step's activations,
+    then the whole backward — the live set grows with the number of
+    microbatches M. Here the backward of microbatch ``i`` starts as soon
+    as its loss cotangent exists: each tick every device does one forward
+    half (receive activation, stash the stage input, send downstream) and
+    one backward half (receive cotangent from downstream, re-run its
+    stage under ``jax.vjp`` from the stashed input, accumulate parameter
+    grads, send the input cotangent upstream). Microbatch ``i``'s stash
+    at stage ``s`` retires after ``2(S-1-s)`` ticks, so a circular buffer
+    of ``2S-1`` slots bounds activation memory by the stage count — the
+    classic 1F1B property (same bubble as non-interleaved GPipe, far less
+    memory). Forward work is recomputed in the backward half
+    (recompute-p, the same trade ``remat=True`` makes on the GPipe path).
+
+    stage_fn: ``(params, act) -> act``, activation shape stage-invariant.
+    loss_fn: ``(loss_params, act, aux_mb) -> scalar mean loss`` applied to
+        the LAST stage's output (e.g. LM head + cross-entropy); its
+        parameter gradients are accumulated on the last stage.
+    stage_params: stage-stacked pytree (leading dim S, sharded over
+        ``axis``); loss_params: replicated pytree.
+    x / aux: ``(M, mb, ...)`` microbatched inputs / loss targets, ``mb``
+        sharded over ``dp_axis`` if given.
+
+    Returns ``(loss, stage_grads, loss_grads, dx)`` — the mean microbatch
+    loss, gradients for the stage stack (sharded like it), for
+    ``loss_params``, and for ``x`` (so the caller can chain upstream
+    layers, e.g. the embedding, through ``jax.vjp``). All gradients are
+    exact for ``mean_i loss_fn(loss_params, stages(x_i), aux_i)`` and are
+    already averaged over ``dp_axis``.
+    """
+    s = mesh.shape[axis]
+    m = x.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != s:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pp axis "
+                f"size {s}")
+    if dp_axis is not None and x.shape[1] % mesh.shape[dp_axis]:
+        raise ValueError(
+            f"dp axis size {mesh.shape[dp_axis]} must divide microbatch "
+            f"size {x.shape[1]}")
+
+    def body(params, lparams, xs, auxs):
+        stage = jax.lax.axis_index(axis)
+        last = s - 1
+        my = jax.tree_util.tree_map(lambda l: l[0], params)
+        fperm = [(j, (j + 1) % s) for j in range(s)]
+        bperm = [(j, (j - 1) % s) for j in range(s)]
+        nstash = 2 * s - 1
+        ticks = m + 2 * s - 2
+
+        zerog = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), my)
+        zerolg = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), lparams)
+        carry0 = (
+            jnp.zeros((nstash,) + xs.shape[1:], xs.dtype),  # input stash
+            jnp.zeros(xs.shape[1:], xs.dtype),              # fwd in-flight
+            jnp.zeros(xs.shape[1:], xs.dtype),              # bwd in-flight
+            zerog, zerolg, jnp.zeros((), jnp.float32),
+        )
+
+        def masked_add(pred, acc, delta):
+            return jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(pred, d.astype(jnp.float32), 0.0),
+                acc, delta)
+
+        def tick(carry, t):
+            stash, fwd_buf, bwd_buf, gacc, lgacc, lacc = carry
+
+            # -- forward half: microbatch f = t - stage ---------------------
+            f = t - stage
+            active_f = (f >= 0) & (f < m)
+            fidx = jnp.clip(f, 0, m - 1)
+            inject = jax.lax.dynamic_index_in_dim(xs, fidx, 0,
+                                                  keepdims=False)
+            a_in = jnp.where(stage == 0, inject, fwd_buf)
+            # Unconditional write is safe: a slot written at tick T0 is
+            # read at T0 + 2(S-1-stage) < T0 + nstash, before reuse.
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, a_in, jnp.mod(t, nstash), 0)
+            y = stage_fn(my, a_in)
+
+            # Loss + its cotangent exist only on the last stage; cond
+            # keeps the head/loss FLOPs off the other stages.
+            aux_mb = jax.lax.dynamic_index_in_dim(auxs, fidx, 0,
+                                                  keepdims=False)
+
+            def do_loss(args):
+                lp, yy, aa = args
+                lval, vjp = jax.vjp(
+                    lambda lp2, y2: loss_fn(lp2, y2, aa), lp, yy)
+                dlp, dy = vjp(jnp.ones((), lval.dtype) / m)
+                return lval, dlp, dy
+
+            def no_loss(args):
+                lp, yy, _ = args
+                return (jnp.zeros((), jnp.float32),
+                        jax.tree_util.tree_map(jnp.zeros_like, lp),
+                        jnp.zeros_like(yy))
+
+            lval, dlp, dy_last = jax.lax.cond(
+                stage == last, do_loss, no_loss, (lparams, y, aux_mb))
+
+            # -- backward half: microbatch b = t - (2S-2-stage) -------------
+            b = t - (2 * s - 2 - stage)
+            active_b = (b >= 0) & (b < m)
+            bidx = jnp.clip(b, 0, m - 1)
+            # The stashed input for microbatch b was written at tick
+            # stage + b.
+            a_stash = jax.lax.dynamic_index_in_dim(
+                stash, jnp.mod(stage + bidx, nstash), 0, keepdims=False)
+            cot_in = jnp.where(stage == last, dy_last,
+                               bwd_buf).astype(y.dtype)
+            _, svjp = jax.vjp(stage_fn, my, a_stash)
+            dmy, da = svjp(cot_in)
+
+            gacc = masked_add(active_b, gacc, dmy)
+            lgacc = masked_add(active_f & (stage == last), lgacc, dlp)
+            lacc = lacc + jnp.where(active_f & (stage == last),
+                                    lval.astype(jnp.float32), 0.0)
+
+            fwd_buf = jax.lax.ppermute(y, axis, fperm)
+            bwd_buf = jax.lax.ppermute(da, axis, bperm)
+            # Keep dx in the activation dtype: the stacked per-tick
+            # output is the schedule's largest buffer, the psum only
+            # adds exact zeros from the other stages, and the later /dp
+            # is a power-of-two scale — f32 here would double it.
+            dx_out = jnp.where((stage == 0) & active_b, da,
+                               jnp.zeros_like(da))
+            return (stash, fwd_buf, bwd_buf, gacc, lgacc, lacc), dx_out
+
+        final, dxs = jax.lax.scan(tick, carry0, jnp.arange(ticks))
+        (_, _, _, gacc, lgacc, lacc) = final
+        # Stage 0's dx for microbatch i lands at tick 2S-2+i; psum over pp
+        # replicates it (every other stage contributed zeros).
+        dx = jax.lax.psum(dxs[2 * s - 2:], axis)
+        loss = jax.lax.psum(lacc, axis) / m
+        lgrads = jax.tree_util.tree_map(lambda l: jax.lax.psum(l, axis),
+                                        lgacc)
+        if dp_axis is not None and mesh.shape.get(dp_axis, 1) > 1:
+            # Each dp replica saw a different slice of every microbatch;
+            # average, matching value_and_grad over the full batch.
+            loss = jax.lax.pmean(loss, dp_axis)
+            gacc = jax.tree_util.tree_map(
+                lambda l: jax.lax.pmean(l, dp_axis), gacc)
+            lgrads = jax.tree_util.tree_map(
+                lambda l: jax.lax.pmean(l, dp_axis), lgrads)
+            # dx stays shard-local (x's mb dim is dp-sharded) but must be
+            # the gradient of the dp-AVERAGED loss, like everything else.
+            dx = dx / mesh.shape[dp_axis]
+        # Re-add the stage dim so out_specs P(axis) scatters the stack.
+        gstack = jax.tree_util.tree_map(lambda l: l[None], gacc)
+        return loss, gstack, lgrads, dx
+
+    xspec = P(None, dp_axis) if dp_axis is not None else P()
+    loss_, gstack, lgrads, dx = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(), xspec, xspec),
+        out_specs=(P(), P(axis), P(), xspec),
+        check_vma=False,
+    )(stage_params, loss_params, x, aux)
+    # Gradients come back f32; match the parameter dtypes.
+    gstack = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), gstack,
+                                    stage_params)
+    lgrads = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), lgrads,
+                                    loss_params)
+    return loss_, gstack, lgrads, dx
