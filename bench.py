@@ -395,7 +395,8 @@ def onchip_attention_check():
     return ncases
 
 
-def _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=False):
+def _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=False,
+                   remat_policy=None):
     """Seconds per TransformerLM fwd+bwd+update step at the given shape."""
     import jax
     import jax.numpy as jnp
@@ -404,6 +405,7 @@ def _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=False):
 
     model = transformer.TransformerLM(vocab=vocab, dim=dim, heads=heads,
                                       layers=layers, remat=remat,
+                                      remat_policy=remat_policy,
                                       compute_dtype=jnp.bfloat16)
     state, tx = transformer.create_train_state(jax.random.key(0), model)
     k1, k2 = jax.random.split(jax.random.key(1))

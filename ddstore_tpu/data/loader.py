@@ -28,6 +28,7 @@ from typing import Callable, Iterable, Iterator, Optional, Sequence
 import numpy as np
 
 from ..utils.metrics import PipelineMetrics
+from ..utils.profile import annotate
 
 try:  # the loader is importable without jax for host-only use
     import jax
@@ -128,7 +129,7 @@ class DeviceLoader:
             yield np.asarray(idx, dtype=np.int64)
 
     def _fetch(self, idx: np.ndarray):
-        with self.metrics.fetch.timed():
+        with self.metrics.fetch.timed(), annotate("ddstore:fetch"):
             batch = (self.dataset(idx) if callable(self.dataset)
                      else self.dataset.fetch(idx))
         if self.transform is not None:
@@ -139,7 +140,7 @@ class DeviceLoader:
                 batch = self.transform(batch)
         if self._sharding is None:
             return batch
-        with self.metrics.stage.timed():
+        with self.metrics.stage.timed(), annotate("ddstore:stage"):
             put = lambda x: jax.make_array_from_process_local_data(
                 self._sharding, np.ascontiguousarray(x))
             # tree_map preserves container types (tuples, NamedTuple

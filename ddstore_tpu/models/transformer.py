@@ -128,6 +128,12 @@ class TransformerLM(nn.Module):
     n_experts: int = 0            # > 0 swaps the MLP for a switch-MoE
     remat: bool = False           # rematerialize blocks (long context:
     #                               trade recompute for activation memory)
+    remat_policy: Optional[str] = None  # name of a jax.checkpoint_policies
+    #                               entry (e.g. "dots_with_no_batch_dims_
+    #                               saveable" keeps matmul outputs and only
+    #                               recomputes the cheap elementwise work —
+    #                               most of full remat's memory win at a
+    #                               fraction of its recompute cost)
 
     @nn.compact
     def __call__(self, tokens, positions):
@@ -135,7 +141,20 @@ class TransformerLM(nn.Module):
         sequence-sharded chunks embed correctly."""
         x = EmbedPE(self.vocab, self.dim, self.compute_dtype,
                     name="embed")(tokens, positions)
-        block_cls = nn.remat(Block) if self.remat else Block
+        if self.remat:
+            policy = None
+            if self.remat_policy:
+                policy = getattr(jax.checkpoint_policies,
+                                 self.remat_policy, None)
+                if policy is None:
+                    valid = sorted(n for n in dir(jax.checkpoint_policies)
+                                   if not n.startswith("_"))
+                    raise ValueError(
+                        f"remat_policy {self.remat_policy!r} is not a "
+                        f"jax.checkpoint_policies entry; valid: {valid}")
+            block_cls = nn.remat(Block, policy=policy)
+        else:
+            block_cls = Block
         for i in range(self.layers):
             x = block_cls(self.dim, self.heads, self.mlp_ratio,
                           self.compute_dtype, self.mesh, self.sp_axis,

@@ -3,6 +3,8 @@
 from .checkpoint import (load_shard, restore_train_state, save_shard,
                          save_train_state)
 from .metrics import LatencyHistogram, PipelineMetrics
+from .profile import annotate, step_annotate, trace
 
 __all__ = ["LatencyHistogram", "PipelineMetrics", "save_train_state",
-           "restore_train_state", "save_shard", "load_shard"]
+           "restore_train_state", "save_shard", "load_shard",
+           "trace", "annotate", "step_annotate"]

@@ -1,0 +1,50 @@
+"""JAX profiler integration (SURVEY §5: the reference's only tracing is
+commented-out printf; the rebuild pairs the host-side latency histograms
+in :mod:`.metrics` with device-side traces).
+
+``trace(logdir)`` captures a TensorBoard/XProf trace of everything inside
+the block — XLA device ops, host callbacks, and any :func:`annotate`d
+host-side phases — viewable with ``tensorboard --logdir`` or xprof.
+``annotate(name)`` marks host-side spans (store fetches, staging) so they
+line up against device activity on the trace timeline; it is a cheap
+no-op when no trace is active, so the data layer can annotate
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+__all__ = ["trace", "annotate", "step_annotate"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, create_perfetto_link: bool = False
+          ) -> Iterator[None]:
+    """Capture a JAX profiler trace of the enclosed block into
+    ``logdir`` (TensorBoard ``plugins/profile`` layout)."""
+    import jax
+
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str, **kwargs):
+    """Named host-side span on the profiler timeline (zero-cost when no
+    trace is active). Usable as context manager or decorator."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+def step_annotate(step: int, name: str = "train_step"):
+    """Step-scoped annotation: groups device ops under one training step
+    in the trace viewer's step-time analysis."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)

@@ -37,6 +37,12 @@ def main():
     p.add_argument("--dp", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", type=str, default=None,
+                   help="jax.checkpoint_policies name for selective "
+                        "remat (e.g. dots_with_no_batch_dims_saveable)")
+    p.add_argument("--profile", type=str, default=None, metavar="LOGDIR",
+                   help="capture a JAX profiler trace of epoch 0 into "
+                        "LOGDIR (view with tensorboard/xprof)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--steps", type=int, default=None)
     args = p.parse_args()
@@ -76,7 +82,9 @@ def main():
 
     model = transformer.TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.dim // 32,
-        layers=args.layers, mesh=mesh, remat=args.remat)
+        layers=args.layers,
+        mesh=mesh, remat=args.remat or args.remat_policy is not None,
+        remat_policy=args.remat_policy)
     state, tx = transformer.create_train_state(
         jax.random.key(args.seed), model, lr=args.lr, mesh=mesh)
     step = transformer.make_train_step(model, tx, mesh=mesh, state=state)
@@ -85,18 +93,28 @@ def main():
                                  store.world_group.rank, seed=args.seed)
     batch = 2 * dp
     pos = jnp.tile(jnp.arange(args.seq, dtype=jnp.int32), (batch, 1))
+    import contextlib
+
+    from ddstore_tpu.utils import step_annotate, trace
     for epoch in range(args.epochs):
         sampler.set_epoch(epoch)
         loader = DeviceLoader(ds, sampler, batch_size=batch, mesh=mesh,
                               spec=jax.P("dp", "sp"))
+        tracing = trace(args.profile) if (args.profile and epoch == 0) \
+            else contextlib.nullcontext()
         t0 = time.perf_counter()
         tot, nb = 0.0, 0
-        for i, (tok, tgt) in enumerate(loader):
-            if args.steps is not None and i >= args.steps:
-                break
-            state, loss = step(state, tok, tgt, pos)
-            tot += float(loss)
-            nb += 1
+        with tracing:
+            for i, (tok, tgt) in enumerate(loader):
+                if args.steps is not None and i >= args.steps:
+                    break
+                with step_annotate(i):
+                    state, loss = step(state, tok, tgt, pos)
+                tot += float(loss)
+                nb += 1
+            # Flush the final async step before stop_trace / timing
+            # (state is always defined, even on zero-step runs).
+            jax.block_until_ready(state)
         dt = time.perf_counter() - t0
         m = loader.metrics.summary()
         if store.rank == 0:
