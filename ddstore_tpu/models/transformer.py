@@ -306,7 +306,8 @@ def _head_apply(model: "TransformerLM", outer, x):
         {"params": outer["params"]["lmhead"]}, x)
 
 
-def _make_stage_fn(model: "TransformerLM", n_stages: int):
+def _make_stage_fn(model: "TransformerLM", n_stages: int,
+                   with_aux: bool = False):
     g = model.layers // n_stages
     blk = Block(model.dim, model.heads, model.mlp_ratio,
                 model.compute_dtype, None, model.sp_axis,
@@ -317,7 +318,19 @@ def _make_stage_fn(model: "TransformerLM", n_stages: int):
             x = blk.apply({"params": stage_params[f"layer{j}"]}, x)
         return x
 
-    return stage_fn
+    def stage_fn_aux(stage_params, x):
+        # Collect the MoE load-balancing aux the blocks sow; scaled by
+        # 1/layers here so summing over stages gives the same
+        # mean-over-layers the sequential step uses
+        # (make_train_step's `aux / model.layers`).
+        side = jnp.zeros((), jnp.float32)
+        for j in range(g):
+            x, inter = blk.apply({"params": stage_params[f"layer{j}"]}, x,
+                                 mutable=("intermediates",))
+            side = side + sum(jax.tree_util.tree_leaves(inter))
+        return x, side / model.layers
+
+    return stage_fn_aux if with_aux else stage_fn
 
 
 def create_pp_train_state(rng: jax.Array, model: TransformerLM,
@@ -347,11 +360,51 @@ def create_pp_train_state(rng: jax.Array, model: TransformerLM,
     return state, tx
 
 
+def _microbatch(x, n_microbatches: int):
+    """(B, ...) -> (M, B//M, ...): THE microbatch-split convention shared
+    by both pipeline schedules (contiguous slices along the batch dim)."""
+    b = x.shape[0]
+    return x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
+
+
+def pp_gpipe_value_and_grad(model: TransformerLM, stage_fn, pp_params,
+                            tokens, targets, positions, *,
+                            n_microbatches: int, mesh: Mesh,
+                            pp_axis: str = "pp",
+                            dp_axis: Optional[str] = None,
+                            remat: bool = False, with_aux: bool = False,
+                            aux_weight: float = 0.0):
+    """Loss + full-model gradients via GPipe (pipeline_apply under
+    autodiff). THE production gradient path of
+    ``make_pp_train_step(schedule="gpipe")`` — tests call it directly."""
+
+    def lossf(pp_params):
+        outer, stages = pp_params
+        x = _embed_apply(model, outer, tokens, positions)
+        b = x.shape[0]
+        xm = _microbatch(x, n_microbatches)
+        if with_aux:
+            ym, aux = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
+                                     axis=pp_axis, dp_axis=dp_axis,
+                                     remat=remat, with_aux=True)
+        else:
+            ym = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
+                                axis=pp_axis, dp_axis=dp_axis, remat=remat)
+            aux = 0.0
+        y = ym.reshape(b, *ym.shape[2:])
+        logits = _head_apply(model, outer, y)
+        return loss_fn(logits, targets) + aux_weight * aux
+
+    return jax.value_and_grad(lossf)(pp_params)
+
+
 def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
                            tokens, targets, positions, *,
                            n_microbatches: int, mesh: Mesh,
                            pp_axis: str = "pp",
-                           dp_axis: Optional[str] = None):
+                           dp_axis: Optional[str] = None,
+                           with_aux: bool = False,
+                           aux_weight: float = 0.0):
     """Loss + full-model gradients via the fused 1F1B schedule.
 
     Embedding runs outside the ring under ``jax.vjp`` (its gradient
@@ -367,9 +420,8 @@ def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
 
     x, embed_vjp = jax.vjp(embed_f, outer["params"]["embed"])
     b = x.shape[0]
-    mb = b // n_microbatches
-    xm = x.reshape(n_microbatches, mb, *x.shape[1:])
-    tm = targets.reshape(n_microbatches, mb, *targets.shape[1:])
+    xm = _microbatch(x, n_microbatches)
+    tm = _microbatch(targets, n_microbatches)
 
     def head_loss(head_params, y, tgt):
         logits = LMHead(model.vocab).apply({"params": head_params}, y)
@@ -377,7 +429,8 @@ def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
 
     loss, gstages, ghead, dxm = pipeline_1f1b(
         stage_fn, head_loss, stages, outer["params"]["lmhead"], xm, tm,
-        mesh=mesh, axis=pp_axis, dp_axis=dp_axis)
+        mesh=mesh, axis=pp_axis, dp_axis=dp_axis, with_aux=with_aux,
+        aux_weight=aux_weight)
     (gembed,) = embed_vjp(dxm.reshape(b, *dxm.shape[2:]))
     return loss, ({"params": {"embed": gembed, "lmhead": ghead}}, gstages)
 
@@ -401,40 +454,35 @@ def make_pp_train_step(model: TransformerLM,
       schedule whose stash is bounded by the stage count (O(S) vs O(M));
       the head + loss run inside the last stage's schedule slot and the
       embedding gradient chains through the returned input cotangent.
+
+    MoE models (``n_experts > 0``) work under both schedules: the Switch
+    load-balancing aux each block sows is threaded through the pipeline
+    as a scalar side-loss channel (GPipe: masked scan output under
+    autodiff; 1F1B: constant scalar cotangent on each stage's backward)
+    and added to the loss with the same 0.01 weight and mean-over-layers
+    normalization as the sequential step. Note the aux is computed per
+    microbatch and averaged — the standard microbatched-MoE definition —
+    whereas the sequential step computes it over the whole batch at
+    once; capacity clipping therefore sees microbatch-sized token sets.
     """
-    if model.n_experts > 0:
-        # The stage_fn applies blocks without mutable intermediates, so
-        # the MoE aux (load-balancing) loss would be silently dropped —
-        # experts would collapse with no error. Refuse rather than
-        # mistrain; compose pp with dense blocks, or ep without pp.
-        raise NotImplementedError(
-            "pipeline parallelism does not yet thread the MoE aux loss; "
-            "use make_train_step with an ep mesh for MoE models")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule: {schedule!r}")
-    stage_fn = _make_stage_fn(model, n_stages)
+    moe = model.n_experts > 0
+    aux_weight = 0.01 if moe else 0.0  # matches make_train_step
+    stage_fn = _make_stage_fn(model, n_stages, with_aux=moe)
     dp = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
 
     def grads_gpipe(pp_params, tokens, targets, positions):
-        def lossf(pp_params):
-            outer, stages = pp_params
-            x = _embed_apply(model, outer, tokens, positions)
-            b = x.shape[0]
-            mb = b // n_microbatches
-            xm = x.reshape(n_microbatches, mb, *x.shape[1:])
-            ym = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
-                                axis=pp_axis, dp_axis=dp, remat=remat)
-            y = ym.reshape(b, *ym.shape[2:])
-            logits = _head_apply(model, outer, y)
-            return loss_fn(logits, targets)
-
-        return jax.value_and_grad(lossf)(pp_params)
+        return pp_gpipe_value_and_grad(
+            model, stage_fn, pp_params, tokens, targets, positions,
+            n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
+            dp_axis=dp, remat=remat, with_aux=moe, aux_weight=aux_weight)
 
     def grads_1f1b(pp_params, tokens, targets, positions):
         return pp_1f1b_value_and_grad(
             model, stage_fn, pp_params, tokens, targets, positions,
             n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
-            dp_axis=dp)
+            dp_axis=dp, with_aux=moe, aux_weight=aux_weight)
 
     grads_of = grads_gpipe if schedule == "gpipe" else grads_1f1b
 

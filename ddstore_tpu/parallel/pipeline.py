@@ -45,17 +45,22 @@ def stack_stage_params(per_stage_params):
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
                    axis: str = "pp", dp_axis: Optional[str] = None,
-                   remat: bool = False):
+                   remat: bool = False, with_aux: bool = False):
     """Run ``x`` through S pipeline stages of ``stage_fn``.
 
     stage_fn: ``(params, act) -> act`` — one stage's computation; the
-        activation shape must be stage-invariant.
+        activation shape must be stage-invariant. With ``with_aux`` it
+        returns ``(act, aux)`` where ``aux`` is a scalar side loss (e.g.
+        MoE load balancing); bubble-step garbage contributions are
+        masked out and the result is differentiable through autodiff.
     stage_params: pytree whose leaves have leading dim S (stage-stacked);
         sharded over ``axis``, replicated over the other mesh axes.
     x: ``(M, mb, ...)`` microbatches. With ``dp_axis`` the ``mb`` dim is
         sharded over it; otherwise x is replicated (small-input path).
     remat: rematerialize ``stage_fn`` in the backward pass.
-    Returns ``(M, mb, ...)`` outputs with the same sharding as ``x``.
+    Returns ``(M, mb, ...)`` outputs with the same sharding as ``x``;
+    with ``with_aux``, ``(outputs, aux)`` where ``aux`` is the
+    per-microbatch mean of the summed stage auxes (dp-averaged).
     """
     s = mesh.shape[axis]
     m = x.shape[0]
@@ -84,28 +89,43 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
             inject = jax.lax.dynamic_index_in_dim(
                 xs, jnp.minimum(t, m - 1), 0, keepdims=False)
             act = jnp.where(stage == 0, inject, buf)
-            y = fn(my, act)
-            return jax.lax.ppermute(y, axis, perm), y
+            if with_aux:
+                y, aux = fn(my, act)
+                # Stage s computes microbatch t-s at step t; fill/drain
+                # steps chew garbage whose aux must not count.
+                valid = (t >= stage) & (t - stage < m)
+                aux = jnp.where(valid, aux.astype(jnp.float32), 0.0)
+            else:
+                y = fn(my, act)
+                aux = jnp.zeros((), jnp.float32)
+            return jax.lax.ppermute(y, axis, perm), (y, aux)
 
-        _, ys = jax.lax.scan(sched, buf, jnp.arange(m + s - 1))
+        _, (ys, auxs) = jax.lax.scan(sched, buf, jnp.arange(m + s - 1))
         # ys[t] on the LAST stage at t >= s-1 is microbatch t-(s-1)'s
         # output; zero elsewhere and psum over pp so every stage's copy
         # of the (dp-sharded) output is identical.
         outs = jnp.where(stage == s - 1, ys[s - 1:], 0.0)
-        return jax.lax.psum(outs, axis)
+        outs = jax.lax.psum(outs, axis)
+        if not with_aux:
+            return outs
+        aux = jax.lax.psum(auxs.sum(), axis) / m
+        if dp_axis is not None and mesh.shape.get(dp_axis, 1) > 1:
+            aux = jax.lax.pmean(aux, dp_axis)
+        return outs, aux
 
     xspec = P(None, dp_axis) if dp_axis is not None else P()
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), xspec),
-        out_specs=xspec,
+        out_specs=(xspec, P()) if with_aux else xspec,
         check_vma=False,
     )(stage_params, x)
 
 
 def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
                   loss_params, x, aux, *, mesh: Mesh, axis: str = "pp",
-                  dp_axis: Optional[str] = None):
+                  dp_axis: Optional[str] = None,
+                  with_aux: bool = False, aux_weight: float = 0.0):
     """1F1B pipeline schedule: fused forward+backward with O(S) activation
     stash per device instead of GPipe-autodiff's O(M).
 
@@ -132,6 +152,12 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
         ``axis``); loss_params: replicated pytree.
     x / aux: ``(M, mb, ...)`` microbatched inputs / loss targets, ``mb``
         sharded over ``dp_axis`` if given.
+    with_aux / aux_weight: when set, ``stage_fn`` returns ``(act,
+        side_loss)`` (e.g. MoE load balancing) and the returned loss
+        includes ``aux_weight * mean_microbatch(sum_stages side_loss)``.
+        The side-loss gradient is injected locally: each stage's
+        backward vjp receives ``aux_weight / M`` as the scalar cotangent
+        alongside the activation cotangent — no extra communication.
 
     Returns ``(loss, stage_grads, loss_grads, dx)`` — the mean microbatch
     loss, gradients for the stage stack (sharded like it), for
@@ -169,7 +195,8 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
             jnp.zeros((nstash,) + xs.shape[1:], xs.dtype),  # input stash
             jnp.zeros(xs.shape[1:], xs.dtype),              # fwd in-flight
             jnp.zeros(xs.shape[1:], xs.dtype),              # bwd in-flight
-            zerog, zerolg, jnp.zeros((), jnp.float32),
+            zerog, zerolg,
+            jnp.zeros((2,), jnp.float32),  # [head loss acc, side-aux acc]
         )
 
         def masked_add(pred, acc, delta):
@@ -191,7 +218,11 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
             # read at T0 + 2(S-1-stage) < T0 + nstash, before reuse.
             stash = jax.lax.dynamic_update_index_in_dim(
                 stash, a_in, jnp.mod(t, nstash), 0)
-            y = stage_fn(my, a_in)
+            if with_aux:
+                y, side = stage_fn(my, a_in)
+            else:
+                y = stage_fn(my, a_in)
+                side = jnp.zeros((), jnp.float32)
 
             # Loss + its cotangent exist only on the last stage; cond
             # keeps the head/loss FLOPs off the other stages.
@@ -225,12 +256,22 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
             cot_in = jnp.where(stage == last, dy_last,
                                bwd_buf).astype(y.dtype)
             _, svjp = jax.vjp(stage_fn, my, a_stash)
-            dmy, da = svjp(cot_in)
+            if with_aux:
+                # The side loss is additive per (stage, microbatch), so
+                # its gradient is a constant scalar cotangent on each
+                # backward — no cross-stage communication needed.
+                side_cot = jnp.where(active_b, aux_weight / m, 0.0)
+                dmy, da = svjp((cot_in, side_cot.astype(jnp.float32)))
+            else:
+                dmy, da = svjp(cot_in)
 
             gacc = masked_add(active_b, gacc, dmy)
             lgacc = masked_add(active_f & (stage == last), lgacc, dlp)
-            lacc = lacc + jnp.where(active_f & (stage == last),
-                                    lval.astype(jnp.float32), 0.0)
+            lacc = lacc + jnp.stack([
+                jnp.where(active_f & (stage == last),
+                          lval.astype(jnp.float32), 0.0),
+                jnp.where(active_f, side.astype(jnp.float32), 0.0),
+            ])
 
             fwd_buf = jax.lax.ppermute(y, axis, fperm)
             bwd_buf = jax.lax.ppermute(da, axis, bperm)
@@ -247,7 +288,8 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
         # Stage 0's dx for microbatch i lands at tick 2S-2+i; psum over pp
         # replicates it (every other stage contributed zeros).
         dx = jax.lax.psum(dxs[2 * s - 2:], axis)
-        loss = jax.lax.psum(lacc, axis) / m
+        accs = jax.lax.psum(lacc, axis) / m
+        loss = accs[0] + aux_weight * accs[1]
         lgrads = jax.tree_util.tree_map(lambda l: jax.lax.psum(l, axis),
                                         lgacc)
         if dp_axis is not None and mesh.shape.get(dp_axis, 1) > 1:

@@ -78,24 +78,19 @@ def _assert_grads_match(mesh, n_stages, n_micro):
     params = model.init(jax.random.key(0), tokens, positions)
     outer, stages = lm_to_stages(params, LAYERS, n_stages)
     stage_fn = transformer._make_stage_fn(model, n_stages)
+    dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
 
-    def loss_pp(pp_params):
-        o, st = pp_params
-        x = transformer._embed_apply(model, o, tokens, positions)
-        b = x.shape[0]
-        xm = x.reshape(n_micro, b // n_micro, *x.shape[1:])
-        from ddstore_tpu.parallel import pipeline_apply
-        dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
-        ym = pipeline_apply(stage_fn, st, xm, mesh=mesh, dp_axis=dp)
-        y = ym.reshape(b, *ym.shape[2:])
-        return transformer.loss_fn(
-            transformer._head_apply(model, o, y), targets)
+    def run(pp_params):
+        # THE production gpipe gradient path.
+        return transformer.pp_gpipe_value_and_grad(
+            model, stage_fn, pp_params, tokens, targets, positions,
+            n_microbatches=n_micro, mesh=mesh, dp_axis=dp)
 
     def loss_seq(params):
         return transformer.loss_fn(
             model.apply(params, tokens, positions), targets)
 
-    g_o, g_st = jax.jit(jax.grad(loss_pp))((outer, stages))
+    _, (g_o, g_st) = jax.jit(run)((outer, stages))
     g_seq = jax.jit(jax.grad(loss_seq))(params)
     merged = lm_from_stages(g_o, g_st, model.layers, n_stages)
     got = dict(jax.tree_util.tree_leaves_with_path(merged))
@@ -237,6 +232,142 @@ def test_1f1b_activation_memory_advantage():
     # Strict ordering is the claim; a generous margin keeps the test
     # stable across XLA versions.
     assert temp["1f1b"] < 0.7 * temp["gpipe"], temp
+
+
+def test_moe_pp_aux_threaded_both_schedules():
+    """MoE under PP (round-2's deliberate refusal, now implemented): the
+    Switch aux loss each block sows is threaded through BOTH pipeline
+    schedules, with loss AND full-model gradients matching a sequential
+    reference that processes the same microbatches (aux is defined per
+    microbatch — capacity clipping sees microbatch-sized token sets)."""
+    n_stages = n_micro = 4
+    mesh = make_mesh({"pp": n_stages})
+    model = transformer.TransformerLM(vocab=VOCAB, dim=DIM, heads=HEADS,
+                                      layers=LAYERS, n_experts=4,
+                                      compute_dtype=jnp.float32)
+    tokens, targets, positions = _batch()
+    params = model.init(jax.random.key(0), tokens, positions)
+    outer, stages = lm_to_stages(params, LAYERS, n_stages)
+    stage_fn = transformer._make_stage_fn(model, n_stages, with_aux=True)
+    b = tokens.shape[0]
+    mb = b // n_micro
+
+    def ref_loss(params):
+        # Sequential, but microbatched exactly like the pipeline.
+        tot = 0.0
+        for i in range(n_micro):
+            sl = slice(i * mb, (i + 1) * mb)
+            logits, inter = model.apply(params, tokens[sl], positions[sl],
+                                        mutable=("intermediates",))
+            aux = sum(jax.tree_util.tree_leaves(inter)) / model.layers
+            tot = tot + transformer.loss_fn(logits, targets[sl]) \
+                + 0.01 * aux
+        return tot / n_micro
+
+    loss_ref, g_ref = jax.jit(jax.value_and_grad(ref_loss))(params)
+    want = dict(jax.tree_util.tree_leaves_with_path(g_ref))
+
+    # Microbatch split along the batch dim must match ref's slices:
+    # reshape(n_micro, mb, ...) does exactly that.
+    def run_gpipe(pp):
+        return transformer.pp_gpipe_value_and_grad(
+            model, stage_fn, pp, tokens, targets, positions,
+            n_microbatches=n_micro, mesh=mesh, with_aux=True,
+            aux_weight=0.01)
+
+    def run_1f1b(pp):
+        return transformer.pp_1f1b_value_and_grad(
+            model, stage_fn, pp, tokens, targets, positions,
+            n_microbatches=n_micro, mesh=mesh, with_aux=True,
+            aux_weight=0.01)
+
+    for name, run in [("gpipe", run_gpipe), ("1f1b", run_1f1b)]:
+        loss, (g_o, g_st) = jax.jit(run)((outer, stages))
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5, err_msg=name)
+        merged = lm_from_stages(g_o, g_st, model.layers, n_stages)
+        got = dict(jax.tree_util.tree_leaves_with_path(merged))
+        assert got.keys() == want.keys()
+        for k in want:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=2e-5,
+                rtol=2e-4, err_msg=f"{name} {k}")
+
+
+def test_moe_pp_dp_aux_exact():
+    """dp x pp MoE exactness: the aux pmean over dp and the 1F1B
+    side-gradient dp averaging match a reference that processes the
+    exact per-(microbatch, dp-shard) token sets the pipeline devices
+    see. Guards the scaling that the loss-decreases smoke test can't."""
+    n_stages = n_micro = ndp = 2
+    mesh = make_mesh({"dp": ndp, "pp": n_stages})
+    model = transformer.TransformerLM(vocab=VOCAB, dim=DIM, heads=HEADS,
+                                      layers=LAYERS, n_experts=4,
+                                      compute_dtype=jnp.float32)
+    tokens, targets, positions = _batch()
+    params = model.init(jax.random.key(0), tokens, positions)
+    outer, stages = lm_to_stages(params, LAYERS, n_stages)
+    stage_fn = transformer._make_stage_fn(model, n_stages, with_aux=True)
+    b = tokens.shape[0]
+    mb = b // n_micro
+    sub = mb // ndp  # rows per (microbatch, dp shard)
+
+    def ref_loss(params):
+        # Each pipeline device applies the stages to ONE dp shard of ONE
+        # microbatch at a time; aux (capacity clipping!) is nonlinear in
+        # the token set, so the reference must slice identically.
+        tot = 0.0
+        for i in range(n_micro):
+            for j in range(ndp):
+                sl = slice(i * mb + j * sub, i * mb + (j + 1) * sub)
+                logits, inter = model.apply(
+                    params, tokens[sl], positions[sl],
+                    mutable=("intermediates",))
+                aux = sum(jax.tree_util.tree_leaves(inter)) / model.layers
+                tot = tot + (transformer.loss_fn(logits, targets[sl])
+                             + 0.01 * aux)
+        return tot / (n_micro * ndp)
+
+    loss_ref, g_ref = jax.jit(jax.value_and_grad(ref_loss))(params)
+    want = dict(jax.tree_util.tree_leaves_with_path(g_ref))
+
+    for name, fn in [("gpipe", transformer.pp_gpipe_value_and_grad),
+                     ("1f1b", transformer.pp_1f1b_value_and_grad)]:
+        def run(pp):
+            return fn(model, stage_fn, pp, tokens, targets, positions,
+                      n_microbatches=n_micro, mesh=mesh, dp_axis="dp",
+                      with_aux=True, aux_weight=0.01)
+
+        loss, (g_o, g_st) = jax.jit(run)((outer, stages))
+        np.testing.assert_allclose(float(loss), float(loss_ref),
+                                   rtol=1e-5, err_msg=name)
+        merged = lm_from_stages(g_o, g_st, model.layers, n_stages)
+        got = dict(jax.tree_util.tree_leaves_with_path(merged))
+        for k in want:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]), atol=2e-5,
+                rtol=2e-4, err_msg=f"{name} {k}")
+
+
+def test_moe_pp_train_step_runs():
+    """make_pp_train_step no longer refuses MoE; both schedules train."""
+    n_stages = 2
+    mesh = make_mesh({"dp": 2, "pp": n_stages})
+    model = transformer.TransformerLM(vocab=VOCAB, dim=DIM, heads=HEADS,
+                                      layers=LAYERS, n_experts=2,
+                                      compute_dtype=jnp.float32)
+    for schedule in ("gpipe", "1f1b"):
+        state, tx = transformer.create_pp_train_state(
+            jax.random.key(0), model, n_stages, lr=1e-2, mesh=mesh)
+        step = transformer.make_pp_train_step(
+            model, tx, mesh, n_stages, n_microbatches=2, donate=False,
+            schedule=schedule)
+        tokens, targets, positions = _batch()
+        losses = []
+        for _ in range(3):
+            state, loss = step(state, tokens, targets, positions)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], (schedule, losses)
 
 
 def test_pp_microbatch_sharding_validated():
