@@ -415,7 +415,8 @@ def _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=False,
 
     def step_fn(st, tok, tgt, pos):
         def lossf(params):
-            return transformer.loss_fn(model.apply(params, tok, pos), tgt)
+            # THE production loss path (fused head auto-on at this vocab).
+            return transformer.lm_loss(model, params, tok, tgt, pos)
 
         loss, grads = jax.value_and_grad(lossf)(st.params)
         updates, opt_state = tx.update(grads, st.opt_state, st.params)

@@ -105,13 +105,20 @@ class EmbedPE(nn.Module):
 
 class LMHead(nn.Module):
     """Final LayerNorm + vocab projection (shared by the sequential and
-    pipelined steps)."""
+    pipelined steps).
+
+    ``features_only=True`` stops after the LayerNorm — the fused
+    cross-entropy path (:func:`ddstore_tpu.ops.xent.fused_linear_xent`)
+    consumes the normalized features and the ``head`` kernel directly so
+    the ``(tokens, vocab)`` logits tensor never materializes."""
 
     vocab: int
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, features_only: bool = False):
         x = nn.LayerNorm(dtype=jnp.float32, name="lnf")(x)
+        if features_only:
+            return x
         return nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                         name="head")(x)
 
@@ -136,9 +143,11 @@ class TransformerLM(nn.Module):
     #                               fraction of its recompute cost)
 
     @nn.compact
-    def __call__(self, tokens, positions):
+    def __call__(self, tokens, positions, return_features: bool = False):
         """tokens/positions: (B, S) int32; positions are GLOBAL indices so
-        sequence-sharded chunks embed correctly."""
+        sequence-sharded chunks embed correctly. ``return_features=True``
+        returns the post-final-LayerNorm features instead of logits (the
+        fused-xent path applies the head kernel itself)."""
         x = EmbedPE(self.vocab, self.dim, self.compute_dtype,
                     name="embed")(tokens, positions)
         if self.remat:
@@ -159,7 +168,13 @@ class TransformerLM(nn.Module):
             x = block_cls(self.dim, self.heads, self.mlp_ratio,
                           self.compute_dtype, self.mesh, self.sp_axis,
                           n_experts=self.n_experts, name=f"block{i}")(x)
-        return LMHead(self.vocab, name="lmhead")(x)
+        return LMHead(self.vocab, name="lmhead")(x, return_features)
+
+
+# Switch-MoE load-balancing aux weight — THE single source for the
+# sequential (lm_loss) and both pipelined (make_pp_train_step) objectives;
+# the PP exactness oracles only stay meaningful if all paths share it.
+MOE_AUX_WEIGHT = 0.01
 
 
 def loss_fn(logits, targets):
@@ -168,6 +183,48 @@ def loss_fn(logits, targets):
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
+
+
+def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
+            fused_xent: Optional[bool] = None,
+            xent_block: int = 8192, mesh: Optional[Mesh] = None):
+    """The LM training loss — THE shared path of :func:`make_train_step`
+    and the bench harness (so what's benchmarked is what trains).
+
+    ``fused_xent`` selects :func:`ddstore_tpu.ops.xent.fused_linear_xent`
+    for the head: the trunk returns post-LayerNorm features and the
+    ``(tokens, vocab)`` logits tensor never materializes — the dominant
+    activation at real vocab sizes. ``None`` auto-enables it at
+    ``vocab >= 8192`` (where the logits tensor starts to dominate HBM
+    traffic) — EXCEPT on a TP mesh: megatron rules shard the head kernel
+    along vocab (tp.py) and the fused vocab-block scan would make GSPMD
+    gather it, so pass ``mesh`` whenever one is in play. The fused head
+    matmul runs in ``model.compute_dtype`` with f32 accumulation; the
+    unfused path keeps the (possibly vocab-sharded) f32 Dense.
+    """
+    if fused_xent is None:
+        tp = mesh is not None and mesh.shape.get("tp", 1) > 1
+        fused_xent = model.vocab >= 8192 and not tp
+    mutable = ("intermediates",) if model.n_experts > 0 else False
+
+    if mutable:
+        out, inter = model.apply(params, tokens, positions, fused_xent,
+                                 mutable=mutable)
+        aux = MOE_AUX_WEIGHT \
+            * sum(jax.tree_util.tree_leaves(inter)) / model.layers
+    else:
+        out = model.apply(params, tokens, positions, fused_xent)
+        aux = 0.0
+    if not fused_xent:
+        return loss_fn(out, targets) + aux
+
+    from ..ops.xent import fused_linear_xent
+
+    w = params["params"]["lmhead"]["head"]["kernel"]
+    nll = fused_linear_xent(
+        out.reshape(-1, out.shape[-1]).astype(model.compute_dtype),
+        w, targets.reshape(-1), xent_block, model.compute_dtype)
+    return nll.mean() + aux
 
 
 class TrainState(NamedTuple):
@@ -214,21 +271,18 @@ def create_train_state(rng: jax.Array, model: TransformerLM,
 
 def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None, donate: bool = True,
-                    state: Optional[TrainState] = None):
+                    state: Optional[TrainState] = None,
+                    fused_xent: Optional[bool] = None):
     """Jitted dp×sp(×tp) train step: (tokens, targets, positions) all
     (B, S), batch over ``dp``, sequence over ``sp``. Pass ``state`` when
     its params carry TP shardings — the step pins them in place (and the
-    gradient/optimizer math stays sharded the same way)."""
+    gradient/optimizer math stays sharded the same way). ``fused_xent``
+    is forwarded to :func:`lm_loss` (default: auto at vocab >= 8192)."""
 
     def step(state: TrainState, tokens, targets, positions):
         def lossf(params):
-            if model.n_experts > 0:
-                logits, inter = model.apply(params, tokens, positions,
-                                            mutable=("intermediates",))
-                aux = sum(jax.tree_util.tree_leaves(inter)) / model.layers
-                return loss_fn(logits, targets) + 0.01 * aux
-            logits = model.apply(params, tokens, positions)
-            return loss_fn(logits, targets)
+            return lm_loss(model, params, tokens, targets, positions,
+                           fused_xent=fused_xent, mesh=mesh)
 
         loss, grads = jax.value_and_grad(lossf)(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -459,7 +513,7 @@ def make_pp_train_step(model: TransformerLM,
     load-balancing aux each block sows is threaded through the pipeline
     as a scalar side-loss channel (GPipe: masked scan output under
     autodiff; 1F1B: constant scalar cotangent on each stage's backward)
-    and added to the loss with the same 0.01 weight and mean-over-layers
+    and added to the loss with the same MOE_AUX_WEIGHT and mean-over-layers
     normalization as the sequential step. Note the aux is computed per
     microbatch and averaged — the standard microbatched-MoE definition —
     whereas the sequential step computes it over the whole batch at
@@ -468,7 +522,7 @@ def make_pp_train_step(model: TransformerLM,
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule: {schedule!r}")
     moe = model.n_experts > 0
-    aux_weight = 0.01 if moe else 0.0  # matches make_train_step
+    aux_weight = MOE_AUX_WEIGHT if moe else 0.0
     stage_fn = _make_stage_fn(model, n_stages, with_aux=moe)
     dp = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
 
