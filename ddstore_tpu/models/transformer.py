@@ -195,8 +195,9 @@ def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
     for the head: the trunk returns post-LayerNorm features and the
     ``(tokens, vocab)`` logits tensor never materializes — the dominant
     activation at real vocab sizes. ``None`` auto-enables it at
-    ``vocab >= 8192`` (where the logits tensor starts to dominate HBM
-    traffic) — EXCEPT on a TP mesh: megatron rules shard the head kernel
+    ``vocab >= 2 * xent_block`` (below that the "fusion" is a single
+    block: full logits tile anyway, plus the backward recompute) — EXCEPT
+    on a TP mesh: megatron rules shard the head kernel
     along vocab (tp.py) and the fused vocab-block scan would make GSPMD
     gather it, so pass ``mesh`` whenever one is in play. The fused head
     matmul runs in ``model.compute_dtype`` with f32 accumulation; the
@@ -204,7 +205,9 @@ def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
     """
     if fused_xent is None:
         tp = mesh is not None and mesh.shape.get("tp", 1) > 1
-        fused_xent = model.vocab >= 8192 and not tp
+        # >= 2 blocks required: a single-block "fusion" still materializes
+        # the full logits tile AND pays the backward recompute.
+        fused_xent = model.vocab >= 2 * xent_block and not tp
     mutable = ("intermediates",) if model.n_experts > 0 else False
 
     if mutable:
@@ -249,6 +252,12 @@ def create_train_state(rng: jax.Array, model: TransformerLM,
     repl = NamedSharding(mesh, P())
     tp = mesh.shape.get("tp", 1) > 1
     ep = mesh.shape.get("ep", 1) > 1
+    if mesh.shape.get("fsdp", 1) > 1 and (tp or ep):
+        # Refuse rather than silently win the elif: the user configured
+        # ZeRO sharding they would not get (params would be fully
+        # replicated across fsdp — correct math, 4x the memory).
+        raise ValueError("fsdp cannot compose with tp/ep yet; use "
+                         "dp x fsdp (or drop the fsdp axis)")
     if ep:
         # Experts over ep (optionally composed with megatron TP).
         params = shard_pytree(params, mesh,
@@ -257,6 +266,12 @@ def create_train_state(rng: jax.Array, model: TransformerLM,
         # Megatron-style TP: place params per the sharding rules; the
         # optimizer state inherits placement via zeros_like.
         params = shard_pytree(params, mesh, megatron_rules("tp"))
+    elif mesh.shape.get("fsdp", 1) > 1:
+        # ZeRO-3: params (and optimizer moments via zeros_like) sharded
+        # across the fsdp axis; XLA all-gathers for compute and
+        # reduce-scatters the gradients.
+        from ..parallel.fsdp import fsdp_rules
+        params = shard_pytree(params, mesh, fsdp_rules(mesh))
     else:
         params = jax.device_put(params, repl)
     state = TrainState(params, tx.init(params),
@@ -292,16 +307,19 @@ def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,
     if mesh is None:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
     repl = NamedSharding(mesh, P())
-    if state is None and (mesh.shape.get("tp", 1) > 1
-                          or mesh.shape.get("ep", 1) > 1):
+    if state is None and any(mesh.shape.get(a, 1) > 1
+                             for a in ("tp", "ep", "fsdp")):
         # Defaulting to replicated here would silently gather the whole
-        # model to every device and undo the TP/EP sharding.
-        raise ValueError("mesh has tp/ep axes: pass the sharded `state` "
-                         "so the step pins its param shardings")
+        # model to every device and undo the TP/EP/FSDP sharding.
+        raise ValueError("mesh has tp/ep/fsdp axes: pass the sharded "
+                         "`state` so the step pins its param shardings")
     state_sh = shardings_of(state) if state is not None else repl
-    dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
+    # The batch shards over every data-like axis: dp, plus fsdp (ZeRO
+    # shards the batch and the params over the SAME axis).
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1) or None
     sp = model.sp_axis if mesh.shape.get(model.sp_axis, 1) > 1 else None
-    seq = NamedSharding(mesh, P(dp, sp))
+    seq = NamedSharding(mesh, P(batch_axes, sp))
     return jax.jit(step, in_shardings=(state_sh, seq, seq, seq),
                    out_shardings=(state_sh, repl),
                    donate_argnums=(0,) if donate else ())

@@ -6,6 +6,7 @@ is delegated entirely to torch DDP/NCCL, SURVEY §2.2); it is the TPU-native
 value-add that connects the host-side store to device meshes.
 """
 
+from .fsdp import fsdp_rules
 from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
                    make_mesh, replicate)
 from .pipeline import (pipeline_1f1b, pipeline_apply,
@@ -25,6 +26,7 @@ __all__ = [
     "global_shuffle_epoch",
     "ring_attention",
     "ring_self_attention",
+    "fsdp_rules",
     "megatron_rules",
     "expert_rules",
     "shard_pytree",
