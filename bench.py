@@ -218,16 +218,27 @@ def _tcp_worker(rank, world, rdv, outfile, num, dim):
 
 
 def tcp_microbench(world=4, num=65536, dim=64):
-    """DCN-path numbers over real processes + sockets on localhost (the
-    reference measures its transport the same way, README.md:182-198)."""
+    """DCN-path numbers over real processes on localhost (the reference
+    measures its transport the same way, README.md:182-198). Three passes:
+    1-connection TCP, striped TCP (both with the same-host CMA fast path
+    forced OFF so the socket path is what's measured), and the CMA
+    process_vm_readv path (what same-host peers actually get)."""
     results = {}
-    for conns, keys in ((1, {"tcp_stripe_gbps": "tcp_stripe_gbps_1conn",
-                             "tcp_batch_gbps": "tcp_batch_gbps_1conn"}),
-                        (4, None)):
+    passes = (
+        ({"DDSTORE_CONNS_PER_PEER": "1", "DDSTORE_CMA": "0"},
+         {"tcp_stripe_gbps": "tcp_stripe_gbps_1conn",
+          "tcp_batch_gbps": "tcp_batch_gbps_1conn"}),
+        ({"DDSTORE_CONNS_PER_PEER": "4", "DDSTORE_CMA": "0"}, None),
+        ({"DDSTORE_CONNS_PER_PEER": "4", "DDSTORE_CMA": "1"},
+         {"tcp_get_p50_us": "cma_get_p50_us",
+          "tcp_stripe_gbps": "cma_stripe_gbps",
+          "tcp_batch_gbps": "cma_batch_gbps"}),
+    )
+    for env, keys in passes:
         rdv = tempfile.mkdtemp()
         outfile = os.path.join(rdv, "bench_out.json")
-        env_backup = os.environ.get("DDSTORE_CONNS_PER_PEER")
-        os.environ["DDSTORE_CONNS_PER_PEER"] = str(conns)
+        backup = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
         try:
             ctx = mp.get_context("spawn")
             procs = [ctx.Process(target=_tcp_worker,
@@ -240,14 +251,15 @@ def tcp_microbench(world=4, num=65536, dim=64):
                 if p.is_alive():
                     p.terminate()
         finally:
-            if env_backup is None:
-                os.environ.pop("DDSTORE_CONNS_PER_PEER", None)
-            else:
-                os.environ["DDSTORE_CONNS_PER_PEER"] = env_backup
+            for k, v in backup.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         if os.path.exists(outfile):
             with open(outfile) as f:
                 got = json.load(f)
-            if keys:  # keep only renamed keys from the 1-conn pass
+            if keys:  # keep only the renamed keys from this pass
                 for src, dst in keys.items():
                     results[dst] = got[src]
             else:

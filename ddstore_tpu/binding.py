@@ -75,6 +75,8 @@ def _load() -> ctypes.CDLL:
     lib.dds_free_var.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.dds_barrier.restype = ctypes.c_int
     lib.dds_barrier.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_cma_ops.restype = _i64
+    lib.dds_cma_ops.argtypes = [ctypes.c_void_p]
     lib.dds_rank.restype = ctypes.c_int
     lib.dds_rank.argtypes = [ctypes.c_void_p]
     lib.dds_world.restype = ctypes.c_int
@@ -241,6 +243,12 @@ class NativeStore:
 
     def barrier(self, tag: int) -> None:
         _check(self._lib.dds_barrier(self._h, tag), "barrier")
+
+    @property
+    def cma_ops(self) -> int:
+        """Reads served via the same-host CMA (process_vm_readv) fast
+        path; 0 for non-TCP backends or when DDSTORE_CMA=0."""
+        return self._lib.dds_cma_ops(self._h)
 
     @property
     def rank(self) -> int:

@@ -131,6 +131,10 @@ int dds_barrier(dds_handle* h, int64_t tag) {
   return h ? h->store->Barrier(tag) : dds::kErrInvalidArg;
 }
 
+int64_t dds_cma_ops(dds_handle* h) {
+  return h && h->tcp ? h->tcp->cma_ops() : 0;
+}
+
 int dds_rank(dds_handle* h) { return h ? h->store->rank() : -1; }
 int dds_world(dds_handle* h) { return h ? h->store->world() : -1; }
 

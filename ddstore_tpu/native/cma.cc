@@ -1,0 +1,234 @@
+#include "cma.h"
+
+#include <fcntl.h>
+#include <sys/prctl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <vector>
+
+namespace dds {
+namespace {
+
+// Plain open()/mmap() on /dev/shm instead of shm_open: identical
+// semantics on Linux, no librt question on older toolchains.
+constexpr char kShmDir[] = "/dev/shm";
+constexpr int kIovMax = 1024;  // Linux IOV_MAX
+constexpr int kSeqlockRetries = 3;
+
+}  // namespace
+
+uint64_t CmaHash(const std::string& name) {
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char c : name) {
+    h ^= c;
+    h *= 1099511628211ull;
+  }
+  // 0 marks an empty slot, ~0 a tombstone; neither may be a name hash.
+  return (h == 0 || h == kCmaTombstone) ? 1 : h;
+}
+
+std::string CmaHostToken() {
+  std::string boot;
+  {
+    std::ifstream f("/proc/sys/kernel/random/boot_id");
+    std::getline(f, boot);
+  }
+  char ns[128] = {0};
+  ssize_t k = ::readlink("/proc/self/ns/pid", ns, sizeof(ns) - 1);
+  if (k < 0) ns[0] = 0;
+  return boot + "|" + ns;
+}
+
+CmaRegistry::CmaRegistry() {
+  char name[96];
+  std::snprintf(name, sizeof(name), "ddscma.%ld.%lx",
+                static_cast<long>(::getpid()),
+                static_cast<unsigned long>(
+                    reinterpret_cast<uintptr_t>(this)));
+  std::string path = std::string(kShmDir) + "/" + name;
+  int fd = ::open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return;
+  if (::ftruncate(fd, sizeof(CmaSegment)) != 0) {
+    ::close(fd);
+    ::unlink(path.c_str());
+    return;
+  }
+  void* p = ::mmap(nullptr, sizeof(CmaSegment), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (p == MAP_FAILED) {
+    ::close(fd);
+    ::unlink(path.c_str());
+    return;
+  }
+  seg_ = static_cast<CmaSegment*>(p);
+  std::memset(seg_, 0, sizeof(CmaSegment));
+  seg_->pid = ::getpid();
+  // Under Yama ptrace_scope=1 (common default) sibling processes get
+  // EPERM from process_vm_readv; opt this process into being readable by
+  // any same-uid peer. Best effort — scope>=2 still (correctly) demotes
+  // peers to TCP via the probe.
+#ifdef PR_SET_PTRACER
+  ::prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
+#endif
+  // magic last: a reader that maps mid-init sees magic==0 and rejects.
+  __atomic_store_n(&seg_->magic, kCmaMagic, __ATOMIC_RELEASE);
+  shm_name_ = name;
+  fd_ = fd;
+}
+
+CmaRegistry::~CmaRegistry() {
+  if (seg_) ::munmap(seg_, sizeof(CmaSegment));
+  if (fd_ >= 0) ::close(fd_);
+  if (!shm_name_.empty())
+    ::unlink((std::string(kShmDir) + "/" + shm_name_).c_str());
+}
+
+CmaSlot* CmaRegistry::FindSlot(uint64_t h, bool take_empty) {
+  // An existing entry for `h` always wins; otherwise the first tombstone
+  // or empty slot on the probe path is reusable. Insertion never skips
+  // past a true empty (nothing for `h` can live beyond it).
+  CmaSlot* insert = nullptr;
+  for (int probe = 0; probe < kCmaSlots; ++probe) {
+    CmaSlot& s = seg_->slots[(h + probe) % kCmaSlots];
+    uint64_t sh = s.hash.load(std::memory_order_relaxed);
+    if (sh == h) return &s;
+    if (sh == kCmaTombstone) {
+      if (take_empty && !insert) insert = &s;
+      continue;
+    }
+    if (sh == 0) {
+      if (take_empty && !insert) insert = &s;
+      break;
+    }
+  }
+  return insert;  // nullptr: absent (or table full — no fast path)
+}
+
+void CmaRegistry::Publish(const std::string& name, const void* base,
+                          int64_t len) {
+  if (!seg_) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  uint64_t h = CmaHash(name);
+  CmaSlot* s = FindSlot(h, /*take_empty=*/true);
+  if (!s) return;
+  s->gen.fetch_add(1, std::memory_order_acq_rel);  // odd: mutating
+  s->hash.store(h, std::memory_order_relaxed);
+  s->base.store(reinterpret_cast<uint64_t>(base),
+                std::memory_order_relaxed);
+  s->len.store(static_cast<uint64_t>(len), std::memory_order_relaxed);
+  s->gen.fetch_add(1, std::memory_order_acq_rel);  // even: stable
+}
+
+void CmaRegistry::Unpublish(const std::string& name) {
+  if (!seg_) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  CmaSlot* s = FindSlot(CmaHash(name), /*take_empty=*/false);
+  if (!s) return;
+  s->gen.fetch_add(1, std::memory_order_acq_rel);
+  s->hash.store(kCmaTombstone, std::memory_order_relaxed);
+  s->len.store(0, std::memory_order_relaxed);
+  s->gen.fetch_add(1, std::memory_order_acq_rel);
+}
+
+CmaPeer* CmaPeer::Open(const std::string& shm_name, int64_t pid) {
+  if (shm_name.empty() || shm_name.find('/') != std::string::npos)
+    return nullptr;
+  std::string path = std::string(kShmDir) + "/" + shm_name;
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) return nullptr;
+  void* p = ::mmap(nullptr, sizeof(CmaSegment), PROT_READ, MAP_SHARED,
+                   fd, 0);
+  ::close(fd);  // the mapping keeps the segment alive
+  if (p == MAP_FAILED) return nullptr;
+  auto* seg = static_cast<CmaSegment*>(p);
+  if (__atomic_load_n(&seg->magic, __ATOMIC_ACQUIRE) != kCmaMagic ||
+      seg->pid != pid) {
+    ::munmap(p, sizeof(CmaSegment));
+    return nullptr;
+  }
+  return new CmaPeer(seg, sizeof(CmaSegment), pid);
+}
+
+CmaPeer::~CmaPeer() {
+  if (seg_) ::munmap(seg_, map_len_);
+}
+
+int CmaPeer::TryReadV(const std::string& name, const ReadOp* ops,
+                      int64_t n) {
+  if (denied_.load(std::memory_order_relaxed)) return kCmaFallback;
+  const uint64_t h = CmaHash(name);
+  // Reader-side probe mirrors FindSlot.
+  CmaSlot* slot = nullptr;
+  for (int probe = 0; probe < kCmaSlots; ++probe) {
+    CmaSlot& s = seg_->slots[(h + probe) % kCmaSlots];
+    uint64_t sh = s.hash.load(std::memory_order_acquire);
+    if (sh == h) {
+      slot = &s;
+      break;
+    }
+    if (sh == kCmaTombstone) continue;  // freed slot: probe past it
+    if (sh == 0) break;  // linear-probe chain ends at first true empty
+  }
+  if (!slot) return kCmaFallback;
+
+  std::vector<iovec> liov, riov;
+  for (int64_t begin = 0; begin < n;) {
+    const int64_t end = std::min(n, begin + kIovMax);
+    bool done = false;
+    for (int attempt = 0; attempt < kSeqlockRetries && !done; ++attempt) {
+      const uint64_t g1 = slot->gen.load(std::memory_order_acquire);
+      if (g1 & 1) continue;  // mutation in progress
+      const uint64_t base = slot->base.load(std::memory_order_relaxed);
+      const uint64_t len = slot->len.load(std::memory_order_relaxed);
+      if (slot->hash.load(std::memory_order_relaxed) != h) break;
+
+      int64_t want = 0;
+      liov.clear();
+      riov.clear();
+      bool bad = false;
+      for (int64_t i = begin; i < end; ++i) {
+        const ReadOp& op = ops[i];
+        if (op.nbytes < 0 || op.offset < 0 ||
+            static_cast<uint64_t>(op.offset) > len ||
+            static_cast<uint64_t>(op.nbytes) >
+                len - static_cast<uint64_t>(op.offset)) {
+          bad = true;  // stale/foreign mapping — let TCP produce the error
+          break;
+        }
+        if (op.nbytes == 0) continue;
+        liov.push_back(iovec{op.dst, static_cast<size_t>(op.nbytes)});
+        riov.push_back(iovec{
+            reinterpret_cast<void*>(base + static_cast<uint64_t>(op.offset)),
+            static_cast<size_t>(op.nbytes)});
+        want += op.nbytes;
+      }
+      if (bad) break;
+      ssize_t got = want == 0
+                        ? 0
+                        : ::process_vm_readv(static_cast<pid_t>(pid_),
+                                             liov.data(), liov.size(),
+                                             riov.data(), riov.size(), 0);
+      if (got < 0 && (errno == EPERM || errno == ESRCH)) {
+        denied_.store(true, std::memory_order_relaxed);
+        return kCmaFallback;
+      }
+      const uint64_t g2 = slot->gen.load(std::memory_order_acquire);
+      if (got == want && g1 == g2) done = true;
+      // else: generation bounced or mapping went away mid-read — the
+      // bytes may be garbage; retry, then fall back.
+    }
+    if (!done) return kCmaFallback;
+    begin = end;
+  }
+  return kOk;
+}
+
+}  // namespace dds

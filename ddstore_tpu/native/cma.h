@@ -1,0 +1,124 @@
+// Cross-memory-attach (CMA) fast path: same-host one-sided reads via
+// process_vm_readv.
+//
+// TPU-VM hosts often run several store processes (one per chip/worker).
+// Reads between them do not need sockets at all: Linux lets a same-uid
+// process read another's address space directly with process_vm_readv —
+// a TRUE one-sided read (single kernel copy, no serving thread, no wire).
+// This is the closest TPU-host analogue of the reference's libfabric
+// FI_MR_BASIC design, which likewise exchanges raw base virtual addresses
+// and reads `remote_address[src] + offset`
+// (/root/reference/src/common.cxx:299-306,340) — except the reference
+// needs RDMA hardware for it, and this needs only the kernel.
+//
+// Safety: the owner publishes {base, len} per variable in a small shared-
+// memory control segment guarded by a per-slot SEQLOCK. Rebind (RAM->mmap
+// spill), Update, and FreeVar bump the generation around the mutation, so
+// a concurrent CMA reader either sees a stable generation (data valid) or
+// retries/falls back to TCP, where the store's shared_mutex serializes it
+// against the mutation. A reader can never return bytes from a freed or
+// half-updated backing with an even, unchanged generation.
+//
+// Discovery is authoritative-by-probe: peers exchange
+// {pid, boot_id + pid-namespace token, segment name} over the TCP control
+// channel; a token match merely permits an attempt — the first
+// process_vm_readv failing with EPERM/ESRCH/EFAULT demotes the peer to
+// TCP permanently. DDSTORE_CMA=0 disables the whole path.
+
+#ifndef DDSTORE_TPU_CMA_H_
+#define DDSTORE_TPU_CMA_H_
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <string>
+
+#include "store.h"
+
+namespace dds {
+
+constexpr uint64_t kCmaMagic = 0xDD5C3A10C0DE0003ull;
+constexpr int kCmaSlots = 256;
+// Unpublish leaves a tombstone, not an empty: readers must probe PAST a
+// freed slot or a hash-colliding variable behind it silently loses its
+// fast path forever.
+constexpr uint64_t kCmaTombstone = ~0ull;
+
+struct CmaSlot {
+  // Seqlock: even = stable, odd = mutation in progress. hash==0 = empty.
+  std::atomic<uint64_t> gen;
+  std::atomic<uint64_t> hash;
+  std::atomic<uint64_t> base;
+  std::atomic<uint64_t> len;
+};
+
+struct CmaSegment {
+  uint64_t magic;
+  int64_t pid;
+  CmaSlot slots[kCmaSlots];
+};
+
+// FNV-1a; 0 is reserved for "empty slot".
+uint64_t CmaHash(const std::string& name);
+
+// Host identity token: boot_id + pid-namespace inode. Equal tokens mean a
+// CMA attempt is worth making (different pid namespaces on one host share
+// a boot_id but cannot process_vm_readv each other — the probe settles it).
+std::string CmaHostToken();
+
+// Publisher side: owns a /dev/shm segment advertising this process's
+// variable mappings.
+class CmaRegistry {
+ public:
+  CmaRegistry();   // creates the segment; ok() false on failure
+  ~CmaRegistry();  // unlinks it
+
+  bool ok() const { return seg_ != nullptr; }
+  const std::string& shm_name() const { return shm_name_; }
+
+  // Seqlock-publish {base, len} for `name` (new slot or in-place rebind).
+  void Publish(const std::string& name, const void* base, int64_t len);
+  // Seqlock-clear the slot; concurrent readers bounce to TCP.
+  void Unpublish(const std::string& name);
+
+ private:
+  CmaSlot* FindSlot(uint64_t h, bool take_empty);
+
+  std::mutex mu_;  // one writer process, many writer threads
+  CmaSegment* seg_ = nullptr;
+  std::string shm_name_;
+  int fd_ = -1;
+};
+
+// Reader side: a peer's mapped segment + pid.
+class CmaPeer {
+ public:
+  ~CmaPeer();
+
+  // Maps `shm_name` and validates magic/pid. nullptr on any failure.
+  static CmaPeer* Open(const std::string& shm_name, int64_t pid);
+
+  // Try to serve `ops` via process_vm_readv. Returns:
+  //   kOk          — all bytes read under a stable generation
+  //   kCmaFallback — mapping absent/changing/denied; caller uses TCP
+  // Never returns partial data as success.
+  static constexpr int kCmaFallback = 1;
+  int TryReadV(const std::string& name, const ReadOp* ops, int64_t n);
+
+  // After EPERM/ESRCH the kernel will never allow this pair; the caller
+  // should drop the peer to TCP permanently.
+  bool denied() const { return denied_.load(std::memory_order_relaxed); }
+
+ private:
+  CmaPeer(CmaSegment* seg, size_t map_len, int64_t pid)
+      : seg_(seg), map_len_(map_len), pid_(pid) {}
+
+  CmaSegment* seg_;
+  size_t map_len_;
+  int64_t pid_;
+  std::atomic<bool> denied_{false};
+};
+
+}  // namespace dds
+
+#endif  // DDSTORE_TPU_CMA_H_

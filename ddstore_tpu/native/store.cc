@@ -75,7 +75,8 @@ int Store::AddInternal(const std::string& name, const void* buf, int64_t nrows,
     v.base = static_cast<char*>(const_cast<void*>(buf));
     v.owned = false;
   }
-  vars_.emplace(name, std::move(v));
+  const VarInfo& placed = vars_.emplace(name, std::move(v)).first->second;
+  transport_->PublishVar(name, placed.base, placed.shard_bytes());
   return kOk;
 }
 
@@ -101,8 +102,12 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
   if (it == vars_.end()) return kErrNotFound;
   VarInfo& v = it->second;
   if (row_offset + nrows > v.nrows) return kErrOutOfRange;
+  // CMA readers are not serialized by mu_; bounce them to the TCP path
+  // (which is) for the duration of the overwrite.
+  transport_->UnpublishVar(name);
   std::memcpy(v.base + row_offset * v.row_bytes(), buf,
               nrows * v.row_bytes());
+  transport_->PublishVar(name, v.base, v.shard_bytes());
   return kOk;
 }
 
@@ -236,9 +241,15 @@ int Store::Rebind(const std::string& name, void* base) {
   if (it == vars_.end()) return kErrNotFound;
   VarInfo& v = it->second;
   if (!base && v.shard_bytes() > 0) return kErrInvalidArg;
+  // Order matters: clear the CMA mapping BEFORE freeing the old backing
+  // (a reader mid-process_vm_readv fails its seqlock recheck and retries
+  // over TCP, where this exclusive lock serializes it), publish the new
+  // backing only once it is in place.
+  transport_->UnpublishVar(name);
   if (v.owned) ::free(v.base);
   v.base = static_cast<char*>(base);
   v.owned = false;
+  transport_->PublishVar(name, v.base, v.shard_bytes());
   return kOk;
 }
 
@@ -246,6 +257,7 @@ int Store::FreeVar(const std::string& name) {
   std::unique_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
+  transport_->UnpublishVar(name);
   if (it->second.owned) ::free(it->second.base);
   vars_.erase(it);
   return kOk;
@@ -253,8 +265,10 @@ int Store::FreeVar(const std::string& name) {
 
 int Store::FreeAll() {
   std::unique_lock<std::shared_mutex> lock(mu_);
-  for (auto& kv : vars_)
+  for (auto& kv : vars_) {
+    transport_->UnpublishVar(kv.first);
     if (kv.second.owned) ::free(kv.second.base);
+  }
   vars_.clear();
   return kOk;
 }

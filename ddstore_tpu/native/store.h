@@ -114,6 +114,17 @@ class Transport {
     return 0;
   }
 
+  // Variable-lifecycle hooks, called by the Store UNDER its exclusive
+  // lock whenever a shard's backing memory appears, changes, or goes
+  // away. Transports with a zero-copy fast path (the CMA/process_vm_readv
+  // path) publish {base, len} to same-host readers here; the default is
+  // a no-op. Publish must be seqlock-atomic against concurrent remote
+  // readers; between Unpublish and the next Publish remote readers must
+  // degrade to the transport's ordinary (lock-serialized) path.
+  virtual void PublishVar(const std::string& name, const void* base,
+                          int64_t nbytes) {}
+  virtual void UnpublishVar(const std::string& name) {}
+
   // Collective tagged barrier across the group. Every rank must issue the
   // same serialized sequence of Barrier calls (matching is positional —
   // the TCP transport pairs barriers by an internal per-transport

@@ -20,7 +20,8 @@ namespace dds {
 namespace {
 
 constexpr uint32_t kMagic = 0xDD57EAD0;
-enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3 };
+enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
+                     kOpCmaInfo = 4 };
 
 #pragma pack(push, 1)
 struct WireReq {
@@ -242,6 +243,15 @@ TcpTransport::TcpTransport(int rank, int world, int port)
   // calls SetLocalIfaces with addresses instead.
   if (const char* env = ::getenv("DDSTORE_IFACES"))
     local_addrs_ = SplitCsv(env);
+
+  // CMA fast path on by default; a failed segment creation (no /dev/shm)
+  // just means no fast path, never an error. Not EnvLong: it treats 0 as
+  // "unset" and would make DDSTORE_CMA=0 a no-op.
+  const char* cma_env = ::getenv("DDSTORE_CMA");
+  if (!cma_env || std::strtol(cma_env, nullptr, 10) != 0) {
+    cma_reg_ = std::make_unique<CmaRegistry>();
+    if (!cma_reg_->ok()) cma_reg_.reset();
+  }
 }
 
 TcpTransport::~TcpTransport() {
@@ -334,6 +344,22 @@ void TcpTransport::HandleConnection(int fd) {
         }
       }
       barrier_cv_.notify_all();
+      continue;
+    }
+    if (req.op == kOpCmaInfo) {
+      // Same-host discovery: "<pid> <host-token> <segment-name|->". The
+      // token (boot_id + pid-namespace) gates whether the caller even
+      // attempts process_vm_readv; the attempt itself is authoritative.
+      static const std::string token = CmaHostToken();
+      char payload[256];
+      int len = std::snprintf(
+          payload, sizeof(payload), "%ld %s %s",
+          static_cast<long>(::getpid()), token.c_str(),
+          cma_reg_ ? cma_reg_->shm_name().c_str() : "-");
+      WireResp resp{kOk, 0, len};
+      if (SendVec(fd, &resp, sizeof(resp), payload,
+                  static_cast<size_t>(len)) != 0)
+        return;
       continue;
     }
     if (req.op == kOpReadVec) {
@@ -613,8 +639,100 @@ int TcpTransport::ReadV(int target, const std::string& name, const ReadOp* ops,
   return ReadVMulti(name, &req, 1);
 }
 
+CmaPeer* TcpTransport::EnsureCmaPeer(Peer& p, int target) {
+  if (!cma_reg_) return nullptr;  // if we can't publish, don't probe either
+  std::lock_guard<std::mutex> lock(p.cma_mu);
+  if (p.cma_state == 1 && p.cma && p.cma->denied()) p.cma_state = -1;
+  if (p.cma_state != 0) return p.cma_state == 1 ? p.cma.get() : nullptr;
+  p.cma_state = -1;  // one probe; any failure below leaves the peer on TCP
+
+  // Info exchange over the peer's first connection. ANY failure after
+  // the request is sent must reset the connection (same convention as
+  // ReadVOn's fail()): a late CmaInfo response left in the stream would
+  // be consumed by the next TCP read as its own.
+  std::string payload;
+  {
+    Conn& c = *p.conns[0];
+    std::lock_guard<std::mutex> clock(c.mu);
+    if (EnsureConnected(p, c) != kOk) return nullptr;
+    auto fail = [&]() {
+      ::close(c.fd);
+      c.fd = -1;
+      return nullptr;
+    };
+    WireReq req{kMagic, kOpCmaInfo, rank_, 0, 0, 0, 0};
+    if (FullSend(c.fd, &req, sizeof(req)) != 0) return fail();
+    WireResp resp;
+    if (FullRecv(c.fd, &resp, sizeof(resp)) != 0) return fail();
+    if (resp.status != kOk || resp.nbytes <= 0 || resp.nbytes > 4096)
+      return fail();
+    payload.resize(static_cast<size_t>(resp.nbytes));
+    if (FullRecv(c.fd, &payload[0], payload.size()) != 0) return fail();
+  }
+  long pid = 0;
+  char token[160] = {0}, shm[96] = {0};
+  if (std::sscanf(payload.c_str(), "%ld %159s %95s", &pid, token, shm) != 3)
+    return nullptr;
+  if (CmaHostToken() != token || std::strcmp(shm, "-") == 0) return nullptr;
+  p.cma.reset(CmaPeer::Open(shm, pid));
+  if (!p.cma) return nullptr;
+  if (DebugOn())
+    std::fprintf(stderr, "[dds r%d] CMA fast path to r%d (pid %ld)\n",
+                 rank_, target, pid);
+  p.cma_state = 1;
+  return p.cma.get();
+}
+
 int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
                              int64_t nreqs) {
+  // Same-host fast path first: whole per-peer op lists served with
+  // process_vm_readv (no sockets, no serving thread, one kernel copy),
+  // peers in parallel on the pool (the kernel copy runs at one core's
+  // memcpy speed; distinct peers are independent). Anything the fast
+  // path can't take — cross-host peers, a mapping mid-rebind, a probe
+  // denial — falls through to the TCP leaves below.
+  std::vector<PeerReadV> rest;
+  if (cma_reg_) {
+    struct CmaTry {
+      const PeerReadV* rq;
+      CmaPeer* peer;
+      int result = CmaPeer::kCmaFallback;
+    };
+    std::vector<CmaTry> tries;
+    rest.reserve(static_cast<size_t>(nreqs));
+    for (int64_t ri = 0; ri < nreqs; ++ri) {
+      const PeerReadV& rq = reqs[ri];
+      CmaPeer* peer = nullptr;
+      if (rq.target >= 0 && rq.target < world_ && rq.target != rank_ &&
+          rq.n > 0)
+        peer = EnsureCmaPeer(*peers_[rq.target], rq.target);
+      if (peer)
+        tries.push_back(CmaTry{&rq, peer});
+      else
+        rest.push_back(rq);
+    }
+    if (!tries.empty()) {
+      TaskGroup group(&pool_);
+      for (size_t ti = 1; ti < tries.size(); ++ti) {
+        CmaTry* t = &tries[ti];
+        group.Launch([t, &name]() {
+          t->result = t->peer->TryReadV(name, t->rq->ops, t->rq->n);
+        });
+      }
+      tries[0].result =
+          tries[0].peer->TryReadV(name, tries[0].rq->ops, tries[0].rq->n);
+      group.Wait();
+      for (CmaTry& t : tries) {
+        if (t.result == kOk)
+          cma_ops_.fetch_add(t.rq->n, std::memory_order_relaxed);
+        else
+          rest.push_back(*t.rq);
+      }
+    }
+    if (rest.empty()) return kOk;
+    reqs = rest.data();
+    nreqs = static_cast<int64_t>(rest.size());
+  }
   // Flatten peers × striped connections into one leaf-task list, then run
   // the leaves on the persistent pool (one inline for guaranteed
   // progress). Flat leaves mean pool tasks never wait on nested pool

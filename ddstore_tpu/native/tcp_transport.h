@@ -28,6 +28,7 @@
 #include <utility>
 #include <vector>
 
+#include "cma.h"
 #include "store.h"
 #include "worker_pool.h"
 
@@ -77,6 +78,20 @@ class TcpTransport : public Transport {
     local_addrs_ = addrs;
   }
 
+  // Variable-lifecycle hooks (Store calls these under its exclusive
+  // lock): publish/clear the local shard mapping in the CMA registry so
+  // same-host peers can read it with process_vm_readv (see cma.h).
+  void PublishVar(const std::string& name, const void* base,
+                  int64_t nbytes) override {
+    if (cma_reg_) cma_reg_->Publish(name, base, nbytes);
+  }
+  void UnpublishVar(const std::string& name) override {
+    if (cma_reg_) cma_reg_->Unpublish(name);
+  }
+  // Ops served via the CMA fast path since construction (observability +
+  // tests asserting the path actually engaged).
+  int64_t cma_ops() const { return cma_ops_.load(); }
+
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
   int ReadV(int target, const std::string& name, const ReadOp* ops,
@@ -107,7 +122,15 @@ class TcpTransport : public Transport {
     std::vector<std::string> hosts;  // one entry per advertised NIC
     int port = -1;
     std::vector<std::unique_ptr<Conn>> conns;
+    // CMA (same-host process_vm_readv) state: 0 = unprobed, 1 = usable,
+    // -1 = TCP only. Probed lazily on first read to the peer.
+    std::mutex cma_mu;
+    int cma_state = 0;
+    std::unique_ptr<CmaPeer> cma;
   };
+
+  // Probe/return the peer's CMA mapping (nullptr = use TCP).
+  CmaPeer* EnsureCmaPeer(Peer& p, int target);
 
   int EnsureConnected(Peer& p, Conn& c);
   // The pipelined request/response loop over one connection.
@@ -136,6 +159,11 @@ class TcpTransport : public Transport {
   // Leaf read tasks (one per peer-connection stripe) run here; threads are
   // created lazily and persist for the transport's lifetime.
   WorkerPool pool_;
+
+  // CMA fast path (DDSTORE_CMA=0 disables): our published mappings and
+  // the fast-path op counter.
+  std::unique_ptr<CmaRegistry> cma_reg_;
+  std::atomic<int64_t> cma_ops_{0};
 
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,

@@ -471,6 +471,11 @@ class DDStore:
     # -- props -------------------------------------------------------------
 
     @property
+    def cma_ops(self) -> int:
+        """Ops served by the same-host CMA (process_vm_readv) fast path."""
+        return self._native.cma_ops
+
+    @property
     def rank(self) -> int:
         return self.group.rank
 
