@@ -503,10 +503,12 @@ def lm_bench():
 
 
 def lm_long_bench():
-    """Long-context flagship number: S=8192 remat TransformerLM train step
-    (tokens/s/chip + MFU). Same model family as lm_bench, batch traded for
-    sequence; remat keeps activation memory at O(sqrt-ish) so the step
-    fits a single chip at 4x the context."""
+    """Long-context flagship number: S=8192 TransformerLM train step
+    (tokens/s/chip + MFU). Same model family as lm_bench, batch traded
+    for sequence. The fused-xent head removed the (tokens, vocab) logits
+    tensor, so on this chip the step fits WITHOUT remat (measured +27%
+    over full remat); smaller-HBM chips fall back to selective remat
+    (matmul outputs saved, elementwise recomputed)."""
     import jax
 
     on_tpu = jax.default_backend() == "tpu"
@@ -516,7 +518,22 @@ def lm_long_bench():
     else:
         vocab, dim, heads, layers, b, s = 256, 64, 4, 2, 1, 256
         lo, hi = 1, 2
-    dt = _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi, remat=True)
+    try:
+        dt = _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi,
+                            remat=False)
+    except Exception as e:  # HBM-limited chip: trade recompute for memory
+        # Only an actual OOM selects the fallback — any other failure in
+        # the no-remat path must fail the bench loudly, not silently
+        # benchmark the remat variant.
+        if "RESOURCE_EXHAUSTED" not in str(e) \
+                and "Out of memory" not in str(e) \
+                and "out of memory" not in str(e):
+            raise
+        print(f"# lm long: no-remat OOM ({type(e).__name__}); "
+              f"falling back to selective remat", file=sys.stderr)
+        dt = _lm_train_time(vocab, dim, heads, layers, b, s, lo, hi,
+                            remat=True,
+                            remat_policy="dots_with_no_batch_dims_saveable")
     toks = b * s / dt
     mfu = _lm_flops_per_step(vocab, dim, layers, b, s) / dt / _peak_flops()
     return toks, mfu, s

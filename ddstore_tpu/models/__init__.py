@@ -11,6 +11,6 @@
   absent in the reference).
 """
 
-from . import gnn, moe, transformer, vae  # noqa: F401
+from . import decode, gnn, moe, transformer, vae  # noqa: F401
 
-__all__ = ["vae", "gnn", "transformer", "moe"]
+__all__ = ["vae", "gnn", "transformer", "moe", "decode"]

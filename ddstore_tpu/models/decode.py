@@ -1,0 +1,142 @@
+"""Incremental (KV-cached) decoding for :class:`TransformerLM`.
+
+Training attends causally over the full sequence; generation wants one
+token at a time against cached K/V — O(S) work per token instead of
+O(S^2) re-prefill. The per-layer math here is applied through the SAME
+flax submodules the training ``Block`` composes (LayerNorm/Dense applied
+with the training param subtrees), so decode cannot drift from what
+trained; the teacher-forcing oracle test pins every position's logits to
+the full forward pass.
+
+The reference has no text model and no inference path at all (its model
+surface is the example VAE, /root/reference/examples/vae/vae-ddp.py:
+174-200); this module is part of the LM family the TPU framework adds.
+
+TPU notes: static shapes throughout — the cache is allocated at
+``max_len`` up front and masked by position, generation is a
+``lax.scan`` over time steps, every matmul keeps the (B, H) batch dims
+so the MXU stays busy even at S=1.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import EmbedPE, LMHead, TransformerLM
+
+Cache = Dict[str, jax.Array]
+
+NEG_INF = float("-inf")
+
+
+def init_cache(model: TransformerLM, batch: int, max_len: int) -> Cache:
+    """Zeroed K/V cache: ``{"k","v"}`` of shape (layers, B, H, L, hd)."""
+    hd = model.dim // model.heads
+    shape = (model.layers, batch, model.heads, max_len, hd)
+    return {"k": jnp.zeros(shape, model.compute_dtype),
+            "v": jnp.zeros(shape, model.compute_dtype)}
+
+
+def decode_step(model: TransformerLM, params, cache: Cache, pos,
+                tokens) -> Tuple[jax.Array, Cache]:
+    """One incremental step: ``tokens`` (B, 1) at position ``pos`` (a
+    traced scalar is fine) -> (logits (B, 1, V), updated cache)."""
+    if model.n_experts > 0:
+        raise NotImplementedError("decode for MoE blocks not implemented")
+    p = params["params"]
+    dt = model.compute_dtype
+    b = tokens.shape[0]
+    hd = model.dim // model.heads
+    max_len = cache["k"].shape[3]
+    scale = 1.0 / math.sqrt(hd)
+
+    positions = jnp.full((b, 1), pos, jnp.int32)
+    x = EmbedPE(model.vocab, model.dim, dt).apply(
+        {"params": p["embed"]}, tokens, positions)
+
+    ln = nn.LayerNorm(dtype=jnp.float32)
+    # Same slot mask for every layer: cache positions <= pos are live.
+    live = (jnp.arange(max_len) <= pos)[None, None, None, :]
+    # Update the stacked 5-D cache in place (dynamic_update_slice on the
+    # scan carry — XLA aliases it; a per-layer slice + stack would copy
+    # the whole cache every generated token).
+    ck_all, cv_all = cache["k"], cache["v"]
+    for i in range(model.layers):
+        bp = p[f"block{i}"]
+        h = ln.apply({"params": bp["ln1"]}, x).astype(dt)
+        qkv = nn.Dense(3 * model.dim, use_bias=False, dtype=dt).apply(
+            {"params": bp["qkv"]}, h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(b, 1, model.heads, hd).transpose(
+            0, 2, 1, 3)  # (B, H, 1, hd)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        ck_all = jax.lax.dynamic_update_slice(ck_all, k[None],
+                                              (i, 0, 0, pos, 0))
+        cv_all = jax.lax.dynamic_update_slice(cv_all, v[None],
+                                              (i, 0, 0, pos, 0))
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, ck_all[i],
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(live, s, NEG_INF)
+        a = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", a.astype(dt), cv_all[i],
+                         preferred_element_type=jnp.float32)
+        out = out.transpose(0, 2, 1, 3).reshape(b, 1, model.dim).astype(dt)
+        x = x + nn.Dense(model.dim, use_bias=False, dtype=dt).apply(
+            {"params": bp["proj"]}, out)
+
+        h = ln.apply({"params": bp["ln2"]}, x).astype(dt)
+        h = nn.Dense(model.mlp_ratio * model.dim, dtype=dt).apply(
+            {"params": bp["up"]}, h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(model.dim, dtype=dt).apply(
+            {"params": bp["down"]}, h)
+
+    logits = LMHead(model.vocab).apply({"params": p["lmhead"]}, x)
+    return logits, {"k": ck_all, "v": cv_all}
+
+
+def generate(model: TransformerLM, params, prompt: jax.Array,
+             max_new_tokens: int, temperature: float = 0.0,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """Autoregressive continuation of ``prompt`` (B, P) int32.
+
+    Returns (B, P + max_new_tokens). ``temperature == 0`` is greedy;
+    otherwise samples from softmax(logits / temperature) using ``key``.
+    Prompt prefill runs through the same cached step (one scan, static
+    shapes, one compilation for any prompt length <= max_len).
+    """
+    if temperature > 0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs `key`")
+    b, plen = prompt.shape
+    total = plen + max_new_tokens
+    cache = init_cache(model, b, total)
+    toks = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
+    keys = jax.random.split(key, total) if temperature > 0 else None
+
+    def body(carry, t):
+        cache, toks = carry
+        cur = jax.lax.dynamic_slice(toks, (0, t), (b, 1))
+        logits, cache = decode_step(model, params, cache, t, cur)
+        lg = logits[:, 0, :].astype(jnp.float32)
+        if temperature > 0:
+            nxt = jax.random.categorical(keys[t], lg / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(lg, axis=-1)
+        nxt = nxt.astype(toks.dtype)[:, None]
+        # Inside the prompt the next token is already known — keep it
+        # (t runs to total-2, so t+1 is always a valid column).
+        keep = jax.lax.dynamic_slice(toks, (0, t + 1), (b, 1))
+        write = jnp.where(t + 1 < plen, keep, nxt)
+        toks = jax.lax.dynamic_update_slice(toks, write, (0, t + 1))
+        return (cache, toks), None
+
+    (_, toks), _ = jax.lax.scan(body, (cache, toks),
+                                jnp.arange(total - 1))
+    return toks

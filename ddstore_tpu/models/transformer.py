@@ -287,19 +287,54 @@ def create_train_state(rng: jax.Array, model: TransformerLM,
 def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,
                     mesh: Optional[Mesh] = None, donate: bool = True,
                     state: Optional[TrainState] = None,
-                    fused_xent: Optional[bool] = None):
+                    fused_xent: Optional[bool] = None,
+                    accum_steps: int = 1):
     """Jitted dp×sp(×tp) train step: (tokens, targets, positions) all
     (B, S), batch over ``dp``, sequence over ``sp``. Pass ``state`` when
     its params carry TP shardings — the step pins them in place (and the
     gradient/optimizer math stays sharded the same way). ``fused_xent``
-    is forwarded to :func:`lm_loss` (default: auto at vocab >= 8192)."""
+    is forwarded to :func:`lm_loss` (default: auto at vocab >= 8192).
+
+    ``accum_steps > 1`` = gradient accumulation: the batch splits into
+    that many equal chunks, a ``lax.scan`` runs fwd+bwd per chunk, and
+    ONE optimizer update applies the averaged gradients — the effective
+    batch trains in 1/accum_steps the activation memory. Because chunks
+    are equal-sized and the loss is a token mean, the update is exactly
+    the big-batch update (the oracle test pins this) — EXCEPT for MoE
+    models, where the Switch aux and capacity clipping see chunk-sized
+    token sets (the same microbatching caveat as make_pp_train_step)."""
+
+    def lossf(params, tok, tgt, pos):
+        return lm_loss(model, params, tok, tgt, pos,
+                       fused_xent=fused_xent, mesh=mesh)
 
     def step(state: TrainState, tokens, targets, positions):
-        def lossf(params):
-            return lm_loss(model, params, tokens, targets, positions,
-                           fused_xent=fused_xent, mesh=mesh)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(lossf)(
+                state.params, tokens, targets, positions)
+        else:
+            if tokens.shape[0] % accum_steps:
+                raise ValueError(f"batch {tokens.shape[0]} not divisible "
+                                 f"by accum_steps {accum_steps}")
+            split = lambda x: x.reshape(accum_steps,
+                                        x.shape[0] // accum_steps,
+                                        *x.shape[1:])
 
-        loss, grads = jax.value_and_grad(lossf)(state.params)
+            def body(carry, chunk):
+                gsum, lsum = carry
+                l, g = jax.value_and_grad(lossf)(state.params, *chunk)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (gsum, lsum + l), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (gsum, lsum), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32)),
+                (split(tokens), split(targets), split(positions)))
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / accum_steps).astype(p.dtype),
+                gsum, state.params)
+            loss = lsum / accum_steps
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss

@@ -1,0 +1,147 @@
+"""KV-cached decoding + gradient-accumulation oracles.
+
+Decode is a reimplementation of the block math against a cache, so it is
+pinned hard: teacher-forced incremental logits must equal the full
+forward pass at EVERY position, and greedy generation must equal the
+naive re-prefill loop token for token. Gradient accumulation must equal
+the big-batch step exactly (equal chunks, token-mean loss).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddstore_tpu.models import decode, transformer
+
+
+def _model(**kw):
+    kw.setdefault("vocab", 64)
+    kw.setdefault("dim", 32)
+    kw.setdefault("heads", 4)
+    kw.setdefault("layers", 2)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return transformer.TransformerLM(**kw)
+
+
+def _params(model, seed=0):
+    tok = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.key(seed), tok,
+                      jnp.tile(jnp.arange(8), (1, 1)))
+
+
+def test_decode_step_matches_full_forward():
+    model = _model()
+    params = _params(model)
+    b, s = 2, 12
+    toks = jax.random.randint(jax.random.key(1), (b, s), 0, model.vocab)
+    pos = jnp.tile(jnp.arange(s), (b, 1))
+    full = model.apply(params, toks, pos)  # (b, s, vocab)
+
+    cache = decode.init_cache(model, b, s)
+    step = jax.jit(lambda c, t, tok: decode.decode_step(
+        model, params, c, t, tok))
+    for t in range(s):
+        logits, cache = step(cache, t, toks[:, t:t + 1])
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"position {t}")
+
+
+def test_generate_greedy_matches_naive():
+    model = _model()
+    params = _params(model)
+    b, plen, new = 2, 5, 6
+    prompt = jax.random.randint(jax.random.key(2), (b, plen), 0,
+                                model.vocab)
+
+    got = jax.jit(lambda p: decode.generate(model, params, p, new))(prompt)
+    assert got.shape == (b, plen + new)
+    np.testing.assert_array_equal(np.asarray(got[:, :plen]),
+                                  np.asarray(prompt))
+
+    # Naive: re-run the full forward for each new token.
+    toks = prompt
+    for _ in range(new):
+        s = toks.shape[1]
+        pos = jnp.tile(jnp.arange(s), (b, 1))
+        logits = model.apply(params, toks, pos)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        toks = jnp.concatenate([toks, nxt[:, None].astype(toks.dtype)],
+                               axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
+
+
+def test_generate_sampling_runs():
+    model = _model()
+    params = _params(model)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    out = decode.generate(model, params, prompt, 4, temperature=1.0,
+                          key=jax.random.key(3))
+    assert out.shape == (1, 7)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out)
+                                             < model.vocab).all()
+
+
+def test_decode_moe_refused():
+    model = _model(n_experts=2)
+    params = _params(model)
+    with pytest.raises(NotImplementedError):
+        decode.decode_step(model, params,
+                           decode.init_cache(model, 1, 4), 0,
+                           jnp.zeros((1, 1), jnp.int32))
+
+
+def test_grad_accum_matches_big_batch():
+    model = _model(vocab=48)
+    state, tx = transformer.create_train_state(jax.random.key(0), model,
+                                               lr=1e-2)
+    b, s = 8, 16
+    kt, kg = jax.random.split(jax.random.key(4))
+    tok = jax.random.randint(kt, (b, s), 0, 48)
+    tgt = jax.random.randint(kg, (b, s), 0, 48)
+    pos = jnp.tile(jnp.arange(s), (b, 1))
+
+    step1 = transformer.make_train_step(model, tx, donate=False)
+    step4 = transformer.make_train_step(model, tx, donate=False,
+                                        accum_steps=4)
+    s1, l1 = step1(state, tok, tgt, pos)
+    s4, l4 = step4(state, tok, tgt, pos)
+    np.testing.assert_allclose(float(l1), float(l4), rtol=1e-5)
+    # Post-adam params: loose tolerance — adam normalizes by sqrt(nu), so
+    # f32 summation-order noise in near-zero grads is amplified ~1e-3.
+    for (path, a), (_, bb) in zip(
+            jax.tree_util.tree_leaves_with_path(s1.params),
+            jax.tree_util.tree_leaves_with_path(s4.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=5e-3, atol=5e-4,
+                                   err_msg=jax.tree_util.keystr(path))
+
+    # The gradients themselves (before adam) match tightly: mean of
+    # equal-chunk token-mean grads == big-batch grad up to reduction
+    # order.
+    def lossf(params, t0, t1, p0):
+        return transformer.lm_loss(model, params, t0, t1, p0)
+
+    g1 = jax.grad(lossf)(state.params, tok, tgt, pos)
+    gs = [jax.grad(lossf)(state.params, tok[i::4], tgt[i::4], pos[i::4])
+          for i in range(4)]
+    g4 = jax.tree_util.tree_map(lambda *x: sum(x) / 4, *gs)
+    for (path, a), (_, bb) in zip(
+            jax.tree_util.tree_leaves_with_path(g1),
+            jax.tree_util.tree_leaves_with_path(g4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_grad_accum_rejects_indivisible():
+    model = _model()
+    state, tx = transformer.create_train_state(jax.random.key(0), model)
+    step = transformer.make_train_step(model, tx, donate=False,
+                                       accum_steps=3)
+    tok = jnp.zeros((4, 8), jnp.int32)
+    pos = jnp.tile(jnp.arange(8), (4, 1))
+    with pytest.raises(ValueError, match="divisible"):
+        step(state, tok, tok, pos)
