@@ -593,6 +593,56 @@ def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
         return best_sps / n_dev, eff, n_dev
 
 
+def gnn_pipeline_bench(graphs=4096, graphs_per_slot=8, warm_epochs=1,
+                       epochs=3):
+    """Store-fed GNN training (the reference's actual workload class —
+    atomistic graphs, README.md:200-212; BASELINE configs 3-5):
+    ragged graphs in the store -> batched ragged fetch -> fixed-budget
+    packing -> jitted MPNN train step. Reports graphs/s/chip + the
+    input-pipeline-efficiency north star."""
+    import jax
+    import numpy as np
+
+    from ddstore_tpu import DDStore, SingleGroup
+    from ddstore_tpu.data import (DeviceLoader, DistributedSampler,
+                                  GraphShardedDataset, synthetic_graphs)
+    from ddstore_tpu.models import gnn
+    from ddstore_tpu.parallel import make_mesh
+
+    n_dev = len(jax.local_devices())
+    mesh = make_mesh({"dp": n_dev}, jax.local_devices())
+    batch = n_dev * graphs_per_slot
+
+    with DDStore(SingleGroup(), backend="local") as store:
+        ds = GraphShardedDataset(
+            store, synthetic_graphs(np.random.default_rng(0), graphs),
+            graphs_per_slot=graphs_per_slot)
+        sampler = DistributedSampler(len(ds), 1, 0, seed=0)
+        model = state = tx = step = None
+        best_gps, eff = 0.0, 0.0
+        for epoch in range(warm_epochs + epochs):
+            sampler.set_epoch(epoch)
+            loader = DeviceLoader(ds, sampler, batch_size=batch, mesh=mesh,
+                                  prefetch=16, workers=8)
+            t0 = time.perf_counter()
+            nb = 0
+            for gb in loader:
+                if model is None:
+                    host_gb = jax.tree.map(np.asarray, gb)
+                    model, state, tx = gnn.create_train_state(
+                        jax.random.key(0), host_gb, mesh=mesh)
+                    step = gnn.make_train_step(model, tx, mesh=mesh)
+                state, loss = step(state, gb)
+                nb += 1
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            if epoch >= warm_epochs:
+                m = loader.metrics.summary()
+                best_gps = max(best_gps, nb * batch / dt)
+                eff = max(eff, m["input_pipeline_efficiency"])
+        return best_gps / n_dev, eff
+
+
 def main():
     extras = {}
 
@@ -612,6 +662,12 @@ def main():
     print(f"# vae pipeline: {sps_chip:.0f} samples/s/chip over {n_dev} "
           f"device(s), input-pipeline efficiency {eff:.3f}",
           file=sys.stderr)
+
+    gps_chip, geff = gnn_pipeline_bench()
+    extras["gnn_graphs_per_sec_per_chip"] = round(gps_chip, 1)
+    extras["gnn_pipeline_eff"] = round(geff, 3)
+    print(f"# gnn pipeline: {gps_chip:.0f} graphs/s/chip, "
+          f"input-pipeline efficiency {geff:.3f}", file=sys.stderr)
 
     ncases = onchip_attention_check()
     extras["onchip_numerics_cases"] = ncases

@@ -45,7 +45,14 @@ def init_cache(model: TransformerLM, batch: int, max_len: int) -> Cache:
 def decode_step(model: TransformerLM, params, cache: Cache, pos,
                 tokens) -> Tuple[jax.Array, Cache]:
     """One incremental step: ``tokens`` (B, 1) at position ``pos`` (a
-    traced scalar is fine) -> (logits (B, 1, V), updated cache)."""
+    traced scalar is fine) -> (logits (B, 1, V), updated cache).
+
+    ``pos`` must be < the cache's ``max_len`` — a concrete out-of-range
+    value raises; a traced one is the caller's contract (generate never
+    violates it). The layer math is deliberately written against the
+    training param subtrees rather than refactoring Block around a cache
+    argument; the teacher-forcing oracle (tests/test_decode.py) turns
+    any drift between the two into a loud test failure."""
     if model.n_experts > 0:
         raise NotImplementedError("decode for MoE blocks not implemented")
     p = params["params"]
@@ -53,6 +60,10 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
     b = tokens.shape[0]
     hd = model.dim // model.heads
     max_len = cache["k"].shape[3]
+    if isinstance(pos, int) and pos >= max_len:
+        raise ValueError(f"pos {pos} >= cache max_len {max_len}: "
+                         "dynamic_update_slice would silently clamp and "
+                         "corrupt the last slot")
     scale = 1.0 / math.sqrt(hd)
 
     positions = jnp.full((b, 1), pos, jnp.int32)
@@ -108,12 +119,17 @@ def generate(model: TransformerLM, params, prompt: jax.Array,
 
     Returns (B, P + max_new_tokens). ``temperature == 0`` is greedy;
     otherwise samples from softmax(logits / temperature) using ``key``.
-    Prompt prefill runs through the same cached step (one scan, static
-    shapes, one compilation for any prompt length <= max_len).
+    Prompt prefill runs through the same cached step. Shapes are static:
+    each distinct (prompt length, max_new_tokens) pair compiles once —
+    callers serving variable-length prompts should pad them to a fixed
+    length to avoid per-length recompiles.
     """
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs `key`")
     b, plen = prompt.shape
+    if plen < 1:
+        raise ValueError("prompt must hold at least one token (column 0 "
+                         "seeds the scan and is never generated)")
     total = plen + max_new_tokens
     cache = init_cache(model, b, total)
     toks = jnp.concatenate(
