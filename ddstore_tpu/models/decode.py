@@ -52,18 +52,26 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
     violates it). The layer math is deliberately written against the
     training param subtrees rather than refactoring Block around a cache
     argument; the teacher-forcing oracle (tests/test_decode.py) turns
-    any drift between the two into a loud test failure."""
-    if model.n_experts > 0:
-        raise NotImplementedError("decode for MoE blocks not implemented")
+    any drift between the two into a loud test failure.
+
+    MoE blocks decode with DROPLESS per-token top-1 routing: each token
+    goes to its argmax expert, no capacity clipping (a single decoded
+    token cannot meaningfully compete for sequence-level capacity).
+    Identical to the training forward wherever training dropped nothing;
+    positions training clipped to zero-output get their expert applied
+    instead — the standard train/infer asymmetry of capacity-factor
+    Switch layers."""
     p = params["params"]
     dt = model.compute_dtype
     b = tokens.shape[0]
     hd = model.dim // model.heads
     max_len = cache["k"].shape[3]
-    if isinstance(pos, int) and pos >= max_len:
-        raise ValueError(f"pos {pos} >= cache max_len {max_len}: "
-                         "dynamic_update_slice would silently clamp and "
-                         "corrupt the last slot")
+    if not isinstance(pos, jax.core.Tracer):
+        ipos = int(pos)
+        if ipos < 0 or ipos >= max_len:
+            raise ValueError(f"pos {ipos} outside cache [0, {max_len}): "
+                             "dynamic_update_slice would silently clamp "
+                             "and corrupt a boundary slot")
     scale = 1.0 / math.sqrt(hd)
 
     positions = jnp.full((b, 1), pos, jnp.int32)
@@ -102,11 +110,31 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
             {"params": bp["proj"]}, out)
 
         h = ln.apply({"params": bp["ln2"]}, x).astype(dt)
-        h = nn.Dense(model.mlp_ratio * model.dim, dtype=dt).apply(
-            {"params": bp["up"]}, h)
-        h = nn.gelu(h)
-        x = x + nn.Dense(model.dim, dtype=dt).apply(
-            {"params": bp["down"]}, h)
+        if model.n_experts > 0:
+            mp = bp["moe"]
+            h2 = h.reshape(b, model.dim)
+            rl = jnp.einsum("bd,de->be", h2.astype(jnp.float32),
+                            mp["router"]["kernel"])
+            probs = jax.nn.softmax(rl, axis=-1)
+            oh = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
+                                model.n_experts, dtype=jnp.float32)
+            gate = jnp.sum(probs * oh, axis=-1)               # (B,)
+            # All-expert compute then one-hot select: E× the FLOPs of one
+            # expert, but static shapes and trivially small at S=1.
+            he = jnp.einsum("bd,edh->beh", h2.astype(dt),
+                            mp["w1"].astype(dt))
+            he = nn.relu(he + mp["b1"][None].astype(dt))
+            oe = jnp.einsum("beh,ehd->bed", he, mp["w2"].astype(dt))
+            oe = oe + mp["b2"][None].astype(dt)
+            y = jnp.einsum("bed,be->bd", oe.astype(jnp.float32), oh)
+            y = (y * gate[:, None]).astype(dt)
+            x = x + y.reshape(b, 1, model.dim)
+        else:
+            h = nn.Dense(model.mlp_ratio * model.dim, dtype=dt).apply(
+                {"params": bp["up"]}, h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(model.dim, dtype=dt).apply(
+                {"params": bp["down"]}, h)
 
     logits = LMHead(model.vocab).apply({"params": p["lmhead"]}, x)
     return logits, {"k": ck_all, "v": cv_all}

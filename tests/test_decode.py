@@ -84,13 +84,27 @@ def test_generate_sampling_runs():
                                              < model.vocab).all()
 
 
-def test_decode_moe_refused():
+def test_decode_moe_matches_full_forward():
+    """MoE decode (dropless per-token routing) must equal the training
+    forward wherever training dropped nothing. With t=16 tokens, E=2 and
+    capacity_factor=2.0, cap = 16 >= t, so training can never clip — the
+    oracle is exact."""
     model = _model(n_experts=2)
     params = _params(model)
-    with pytest.raises(NotImplementedError):
-        decode.decode_step(model, params,
-                           decode.init_cache(model, 1, 4), 0,
-                           jnp.zeros((1, 1), jnp.int32))
+    b, s = 2, 8
+    toks = jax.random.randint(jax.random.key(5), (b, s), 0, model.vocab)
+    pos = jnp.tile(jnp.arange(s), (b, 1))
+    full = model.apply(params, toks, pos)
+
+    cache = decode.init_cache(model, b, s)
+    step = jax.jit(lambda c, t, tok: decode.decode_step(
+        model, params, c, t, tok))
+    for t in range(s):
+        logits, cache = step(cache, t, toks[:, t:t + 1])
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"moe position {t}")
 
 
 def test_grad_accum_matches_big_batch():
