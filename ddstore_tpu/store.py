@@ -125,9 +125,13 @@ class DDStore:
             self.num_replicas = 1
 
         if backend == "auto":
-            backend = ("local" if isinstance(self.group,
-                                             (SingleGroup, ThreadGroup))
-                       else "tcp")
+            # Env override first (the reference selects its backend the
+            # same way: DDSTORE_METHOD, distdataset.py:32), then by
+            # group kind.
+            backend = os.environ.get("DDSTORE_BACKEND", "").strip() \
+                or ("local" if isinstance(self.group,
+                                          (SingleGroup, ThreadGroup))
+                    else "tcp")
         self.backend = backend
         self.copy = copy
         self._meta: Dict[str, _VarMeta] = {}

@@ -22,8 +22,8 @@ from typing import Any, Optional
 
 import numpy as np
 
-__all__ = ["save_train_state", "restore_train_state", "save_shard",
-           "load_shard"]
+__all__ = ["save_train_state", "save_train_state_async",
+           "restore_train_state", "save_shard", "load_shard"]
 
 
 def _ckpt_path(path: str) -> str:
@@ -36,6 +36,42 @@ def save_train_state(path: str, state: Any) -> None:
 
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(_ckpt_path(path), state, force=True)
+
+
+class AsyncSave:
+    """Handle for an in-flight async checkpoint: ``wait()`` blocks until
+    the write is durable and releases the checkpointer. The handle keeps
+    the checkpointer alive — dropping it without ``wait()`` risks a
+    partial checkpoint at process exit."""
+
+    def __init__(self, ckptr):
+        self._ckptr = ckptr
+
+    def wait(self) -> None:
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+            self._ckptr.close()
+            self._ckptr = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+
+
+def save_train_state_async(path: str, state: Any) -> AsyncSave:
+    """Start writing a pytree checkpoint WITHOUT blocking the train loop:
+    device arrays are snapshotted to host, then serialized on background
+    threads while training continues (orbax AsyncCheckpointer). Call
+    ``.wait()`` (or use as a context manager) before the next save to the
+    same path or before process exit."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    ckptr.save(_ckpt_path(path), args=ocp.args.StandardSave(state),
+               force=True)
+    return AsyncSave(ckptr)
 
 
 def restore_train_state(path: str, like: Any) -> Any:

@@ -143,7 +143,8 @@ def main():
         cont = np.asarray(out[0, plen:])
         want = corpus[int(starts[0]) + plen:
                       int(starts[0]) + plen + args.generate]
-        acc = float((cont == want[:len(cont)]).mean())
+        n = min(len(cont), len(want))  # corpus may end mid-continuation
+        acc = float((cont[:n] == want[:n]).mean()) if n else float("nan")
         print(f"generate: {args.generate} tokens, pattern accuracy "
               f"{acc:.2f}: {cont[:24].tolist()}", flush=True)
     store.close()
