@@ -132,6 +132,14 @@ class DDStore:
                 or ("local" if isinstance(self.group,
                                           (SingleGroup, ThreadGroup))
                     else "tcp")
+        if backend == "local" and not isinstance(
+                self.group, (SingleGroup, ThreadGroup)):
+            # The local backend's registry is per-process; with real
+            # multi-process ranks every process would wait forever for
+            # peers that can never join its registry.
+            raise ValueError(
+                "backend 'local' requires a single-process group "
+                f"(got {type(self.group).__name__}); use 'tcp'")
         self.backend = backend
         self.copy = copy
         self._meta: Dict[str, _VarMeta] = {}
