@@ -132,14 +132,16 @@ class DDStore:
                 or ("local" if isinstance(self.group,
                                           (SingleGroup, ThreadGroup))
                     else "tcp")
-        if backend == "local" and not isinstance(
+        if backend == "local" and self.group.size > 1 and not isinstance(
                 self.group, (SingleGroup, ThreadGroup)):
-            # The local backend's registry is per-process; with real
-            # multi-process ranks every process would wait forever for
-            # peers that can never join its registry.
+            # The local backend's registry is per-process; with ranks in
+            # separate processes every rank would wait forever for peers
+            # that can never join its registry. Size-1 groups of any kind
+            # are trivially process-local.
             raise ValueError(
-                "backend 'local' requires a single-process group "
-                f"(got {type(self.group).__name__}); use 'tcp'")
+                "backend 'local' requires all ranks in one process "
+                f"(got {type(self.group).__name__} of size "
+                f"{self.group.size}); use 'tcp'")
         self.backend = backend
         self.copy = copy
         self._meta: Dict[str, _VarMeta] = {}
