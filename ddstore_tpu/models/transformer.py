@@ -408,9 +408,24 @@ def _embed_apply(model: "TransformerLM", outer, tokens, positions):
         {"params": outer["params"]["embed"]}, tokens, positions)
 
 
-def _head_apply(model: "TransformerLM", outer, x):
-    return LMHead(model.vocab).apply(
-        {"params": outer["params"]["lmhead"]}, x)
+def _head_xent(model: "TransformerLM", lmhead_params, y, targets,
+               fused: bool, xent_block: int = 8192):
+    """LM head + token-mean cross-entropy from post-block activations —
+    THE shared head of both pipeline schedules. ``fused`` routes through
+    :func:`ddstore_tpu.ops.xent.fused_linear_xent` (vocab-blocked online
+    logsumexp; the per-microbatch ``(tokens, vocab)`` logits tensor never
+    materializes), matching :func:`lm_loss`'s fused path."""
+    if not fused:
+        logits = LMHead(model.vocab).apply({"params": lmhead_params}, y)
+        return loss_fn(logits, targets)
+    from ..ops.xent import fused_linear_xent
+
+    feats = LMHead(model.vocab).apply({"params": lmhead_params}, y, True)
+    w = lmhead_params["head"]["kernel"]
+    nll = fused_linear_xent(
+        feats.reshape(-1, feats.shape[-1]).astype(model.compute_dtype),
+        w, targets.reshape(-1), xent_block, model.compute_dtype)
+    return nll.mean()
 
 
 def _make_stage_fn(model: "TransformerLM", n_stages: int,
@@ -480,7 +495,9 @@ def pp_gpipe_value_and_grad(model: TransformerLM, stage_fn, pp_params,
                             pp_axis: str = "pp",
                             dp_axis: Optional[str] = None,
                             remat: bool = False, with_aux: bool = False,
-                            aux_weight: float = 0.0):
+                            aux_weight: float = 0.0,
+                            fused_xent: bool = False,
+                            xent_block: int = 8192):
     """Loss + full-model gradients via GPipe (pipeline_apply under
     autodiff). THE production gradient path of
     ``make_pp_train_step(schedule="gpipe")`` — tests call it directly."""
@@ -499,8 +516,8 @@ def pp_gpipe_value_and_grad(model: TransformerLM, stage_fn, pp_params,
                                 axis=pp_axis, dp_axis=dp_axis, remat=remat)
             aux = 0.0
         y = ym.reshape(b, *ym.shape[2:])
-        logits = _head_apply(model, outer, y)
-        return loss_fn(logits, targets) + aux_weight * aux
+        return _head_xent(model, outer["params"]["lmhead"], y, targets,
+                          fused_xent, xent_block) + aux_weight * aux
 
     return jax.value_and_grad(lossf)(pp_params)
 
@@ -511,7 +528,9 @@ def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
                            pp_axis: str = "pp",
                            dp_axis: Optional[str] = None,
                            with_aux: bool = False,
-                           aux_weight: float = 0.0):
+                           aux_weight: float = 0.0,
+                           fused_xent: bool = False,
+                           xent_block: int = 8192):
     """Loss + full-model gradients via the fused 1F1B schedule.
 
     Embedding runs outside the ring under ``jax.vjp`` (its gradient
@@ -531,8 +550,8 @@ def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
     tm = _microbatch(targets, n_microbatches)
 
     def head_loss(head_params, y, tgt):
-        logits = LMHead(model.vocab).apply({"params": head_params}, y)
-        return loss_fn(logits, tgt)
+        return _head_xent(model, head_params, y, tgt, fused_xent,
+                          xent_block)
 
     loss, gstages, ghead, dxm = pipeline_1f1b(
         stage_fn, head_loss, stages, outer["params"]["lmhead"], xm, tm,
@@ -547,7 +566,9 @@ def make_pp_train_step(model: TransformerLM,
                        n_stages: int, n_microbatches: int,
                        pp_axis: str = "pp", dp_axis: str = "dp",
                        donate: bool = True, remat: bool = False,
-                       schedule: str = "gpipe"):
+                       schedule: str = "gpipe",
+                       fused_xent: Optional[bool] = None,
+                       xent_block: int = 8192):
     """Jitted dp×pp train step over ``(tokens, targets, positions)``.
 
     The batch dim must be ``n_microbatches * mb`` with ``mb`` divisible
@@ -574,6 +595,12 @@ def make_pp_train_step(model: TransformerLM,
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule: {schedule!r}")
+    if fused_xent is None:
+        # THE same auto rule as lm_loss (>= 2 blocks or fusing is pure
+        # overhead); PP never composes with megatron TP here, so no tp
+        # guard needed. The fused head pays off per MICROBATCH: the
+        # (mb_tokens, vocab) logits tensor never materializes.
+        fused_xent = model.vocab >= 2 * xent_block
     moe = model.n_experts > 0
     aux_weight = MOE_AUX_WEIGHT if moe else 0.0
     stage_fn = _make_stage_fn(model, n_stages, with_aux=moe)
@@ -583,13 +610,15 @@ def make_pp_train_step(model: TransformerLM,
         return pp_gpipe_value_and_grad(
             model, stage_fn, pp_params, tokens, targets, positions,
             n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
-            dp_axis=dp, remat=remat, with_aux=moe, aux_weight=aux_weight)
+            dp_axis=dp, remat=remat, with_aux=moe, aux_weight=aux_weight,
+            fused_xent=fused_xent, xent_block=xent_block)
 
     def grads_1f1b(pp_params, tokens, targets, positions):
         return pp_1f1b_value_and_grad(
             model, stage_fn, pp_params, tokens, targets, positions,
             n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
-            dp_axis=dp, with_aux=moe, aux_weight=aux_weight)
+            dp_axis=dp, with_aux=moe, aux_weight=aux_weight,
+            fused_xent=fused_xent, xent_block=xent_block)
 
     grads_of = grads_gpipe if schedule == "gpipe" else grads_1f1b
 
