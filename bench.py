@@ -695,7 +695,7 @@ def main():
     extras["lm_long_tokens_per_sec_per_chip"] = round(ltoks, 0)
     extras["lm_long_mfu"] = round(lmfu, 4)
     extras["lm_long_seq"] = ls
-    print(f"# lm long-context: S={ls} remat, {ltoks:.0f} tokens/s/chip, "
+    print(f"# lm long-context: S={ls}, {ltoks:.0f} tokens/s/chip, "
           f"MFU={lmfu:.3f}", file=sys.stderr)
 
     print(json.dumps({
