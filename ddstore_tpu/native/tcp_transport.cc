@@ -193,6 +193,38 @@ long EnvLong(const char* name, long dflt) {
   return dflt;
 }
 
+// Split ops into `nlists` round-robin chunk lists of ~`chunk` bytes
+// (shared by TCP connection striping and CMA part striping — one loop to
+// keep correct). Ops with nbytes <= 0 pass through UNSPLIT so the
+// downstream validation still sees and rejects them instead of them
+// silently vanishing from every list.
+std::vector<std::vector<dds::ReadOp>> DealChunks(const dds::ReadOp* ops,
+                                                 int64_t n, int64_t chunk,
+                                                 int nlists) {
+  std::vector<std::vector<dds::ReadOp>> lists(
+      static_cast<size_t>(nlists));
+  int next = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (ops[i].nbytes <= 0) {
+      lists[static_cast<size_t>(next)].push_back(ops[i]);
+      next = (next + 1) % nlists;
+      continue;
+    }
+    int64_t off = ops[i].offset, left = ops[i].nbytes;
+    char* dst = static_cast<char*>(ops[i].dst);
+    while (left > 0) {
+      int64_t take = left < chunk ? left : chunk;
+      lists[static_cast<size_t>(next)].push_back(
+          dds::ReadOp{off, take, dst});
+      next = (next + 1) % nlists;
+      off += take;
+      dst += take;
+      left -= take;
+    }
+  }
+  return lists;
+}
+
 }  // namespace
 
 TcpTransport::TcpTransport(int rank, int world, int port)
@@ -693,10 +725,19 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   // denial — falls through to the TCP leaves below.
   std::vector<PeerReadV> rest;
   if (cma_reg_) {
+    // One process_vm_readv copies at a single core's memcpy speed; big
+    // reads are split into ~4 MiB chunks dealt across up to 8 parallel
+    // part-lists per peer (mirrors the TCP path's connection striping).
+    constexpr int64_t kCmaChunk = 4 << 20;
+    constexpr int kCmaMaxPar = 8;
     struct CmaTry {
       const PeerReadV* rq;
       CmaPeer* peer;
-      int result = CmaPeer::kCmaFallback;
+      std::vector<std::vector<ReadOp>> owned;  // backing when split
+      // (ops, n) views: the caller's array for single-part requests (no
+      // copy on the common small-read path), `owned` when split.
+      std::vector<std::pair<const ReadOp*, int64_t>> spans;
+      std::vector<int> results;
     };
     std::vector<CmaTry> tries;
     rest.reserve(static_cast<size_t>(nreqs));
@@ -706,26 +747,63 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
       if (rq.target >= 0 && rq.target < world_ && rq.target != rank_ &&
           rq.n > 0)
         peer = EnsureCmaPeer(*peers_[rq.target], rq.target);
-      if (peer)
-        tries.push_back(CmaTry{&rq, peer});
-      else
+      if (!peer) {
         rest.push_back(rq);
+        continue;
+      }
+      CmaTry t{&rq, peer, {}, {}, {}};
+      int64_t total = 0;
+      for (int64_t i = 0; i < rq.n; ++i) total += rq.ops[i].nbytes;
+      int nparts = 1;
+      if (total > 2 * kCmaChunk)
+        nparts = static_cast<int>(std::min<int64_t>(
+            kCmaMaxPar, (total + kCmaChunk - 1) / kCmaChunk));
+      if (nparts == 1) {
+        t.spans.emplace_back(rq.ops, rq.n);
+      } else {
+        t.owned = DealChunks(rq.ops, rq.n, kCmaChunk, nparts);
+        for (const auto& part : t.owned)
+          if (!part.empty())
+            t.spans.emplace_back(part.data(),
+                                 static_cast<int64_t>(part.size()));
+      }
+      t.results.assign(t.spans.size(), CmaPeer::kCmaFallback);
+      tries.push_back(std::move(t));
     }
     if (!tries.empty()) {
       TaskGroup group(&pool_);
-      for (size_t ti = 1; ti < tries.size(); ++ti) {
-        CmaTry* t = &tries[ti];
-        group.Launch([t, &name]() {
-          t->result = t->peer->TryReadV(name, t->rq->ops, t->rq->n);
-        });
+      bool first = true;
+      CmaTry* inline_try = nullptr;
+      size_t inline_pi = 0;
+      for (CmaTry& t : tries) {
+        for (size_t pi = 0; pi < t.spans.size(); ++pi) {
+          if (first) {  // one leaf inline for guaranteed progress
+            inline_try = &t;
+            inline_pi = pi;
+            first = false;
+            continue;
+          }
+          CmaTry* tp = &t;
+          int* res = &t.results[pi];
+          const auto* span = &t.spans[pi];
+          group.Launch([tp, res, span, &name]() {
+            *res = tp->peer->TryReadV(name, span->first, span->second);
+          });
+        }
       }
-      tries[0].result =
-          tries[0].peer->TryReadV(name, tries[0].rq->ops, tries[0].rq->n);
+      if (inline_try)
+        inline_try->results[inline_pi] = inline_try->peer->TryReadV(
+            name, inline_try->spans[inline_pi].first,
+            inline_try->spans[inline_pi].second);
       group.Wait();
       for (CmaTry& t : tries) {
-        if (t.result == kOk)
+        bool ok = true;
+        for (int r : t.results) ok = ok && r == kOk;
+        if (ok)
           cma_ops_.fetch_add(t.rq->n, std::memory_order_relaxed);
         else
+          // All-or-nothing per peer: TCP redoes the whole request (the
+          // parts that DID land wrote the same bytes TCP will write).
           rest.push_back(*t.rq);
       }
     }
@@ -769,20 +847,8 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
 
     // Chunk big ops, then deal chunks round-robin (they are similar
     // sizes, so this balances bytes well without a sort).
-    std::vector<std::vector<ReadOp>> lists(nconn);
-    int next = 0;
-    for (int64_t i = 0; i < rq.n; ++i) {
-      int64_t off = rq.ops[i].offset, left = rq.ops[i].nbytes;
-      char* dst = static_cast<char*>(rq.ops[i].dst);
-      while (left > 0) {
-        int64_t take = left < kStripeBytes ? left : kStripeBytes;
-        lists[next].push_back(ReadOp{off, take, dst});
-        next = (next + 1) % nconn;
-        off += take;
-        dst += take;
-        left -= take;
-      }
-    }
+    std::vector<std::vector<ReadOp>> lists =
+        DealChunks(rq.ops, rq.n, kStripeBytes, nconn);
     for (int ci = 0; ci < nconn; ++ci)
       if (!lists[ci].empty())
         leaves.push_back(Leaf{&p, p.conns[ci].get(), std::move(lists[ci])});
