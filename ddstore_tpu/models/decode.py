@@ -147,40 +147,77 @@ def generate(model: TransformerLM, params, prompt: jax.Array,
 
     Returns (B, P + max_new_tokens). ``temperature == 0`` is greedy;
     otherwise samples from softmax(logits / temperature) using ``key``.
-    Prompt prefill runs through the same cached step. Shapes are static:
-    each distinct (prompt length, max_new_tokens) pair compiles once —
-    callers serving variable-length prompts should pad them to a fixed
-    length to avoid per-length recompiles.
+    The prompt prefills in ONE full forward pass (the blocks ``sow``
+    their K/V heads, which seed the cache) — O(1) sequential steps for
+    the prompt instead of O(P) — then a ``lax.scan`` of cached steps
+    decodes the new tokens. Shapes are static: each distinct (prompt
+    length, max_new_tokens) pair compiles once — callers serving
+    variable-length prompts should pad them to a fixed length to avoid
+    per-length recompiles.
     """
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs `key`")
+    if max_new_tokens <= 0:
+        return prompt
     b, plen = prompt.shape
     if plen < 1:
-        raise ValueError("prompt must hold at least one token (column 0 "
-                         "seeds the scan and is never generated)")
+        raise ValueError("prompt must hold at least one token (the first "
+                         "new token is conditioned on it)")
     total = plen + max_new_tokens
     cache = init_cache(model, b, total)
-    toks = jnp.concatenate(
-        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
     keys = jax.random.split(key, total) if temperature > 0 else None
+
+    def pick(lg, t):
+        lg = lg.astype(jnp.float32)
+        if temperature > 0:
+            nxt = jax.random.categorical(keys[t], lg / temperature,
+                                         axis=-1)
+        else:
+            nxt = jnp.argmax(lg, axis=-1)
+        return nxt.astype(prompt.dtype)[:, None]
+
+    # Prefill: one full forward over the prompt; blocks sow per-layer K/V
+    # (B, H, plen, hd) which seed the cache, and the last position's
+    # features produce the first new token (the head applies to that one
+    # position only — the (B, plen, vocab) logits never materialize).
+    # For dense models this is numerically the same stream as stepping
+    # the prompt token by token (the greedy-vs-naive oracle pins it);
+    # for MoE models the prefill applies TRAINING routing (capacity
+    # clipping over the whole prompt), then cached steps are dropless —
+    # the same train/infer asymmetry decode_step documents.
+    pm = model.clone(mesh=None, remat=False, sow_kv=True)
+    positions = jnp.tile(jnp.arange(plen, dtype=jnp.int32), (b, 1))
+    feats, inter = pm.apply(params, prompt, positions, True,
+                            mutable=("intermediates",))
+    ks, vs = [], []
+    for i in range(model.layers):
+        (k, v), = inter["intermediates"][f"block{i}"]["kv"]
+        ks.append(k.astype(model.compute_dtype))
+        vs.append(v.astype(model.compute_dtype))
+    cache = {
+        "k": cache["k"].at[:, :, :, :plen, :].set(jnp.stack(ks)),
+        "v": cache["v"].at[:, :, :, :plen, :].set(jnp.stack(vs)),
+    }
+    # feats are already post-lnf (features_only applies the LayerNorm);
+    # apply ONLY the vocab projection — LMHead.apply here would LayerNorm
+    # a second time, invisible at init (scale=1, bias=0 makes LN o LN a
+    # no-op) but wrong for any trained model.
+    w = params["params"]["lmhead"]["head"]["kernel"]
+    last_logits = feats[:, -1, :].astype(jnp.float32) @ w.astype(
+        jnp.float32)
+    first = pick(last_logits, plen - 1)
+    toks = jnp.concatenate(
+        [prompt, first, jnp.zeros((b, max_new_tokens - 1), prompt.dtype)],
+        axis=1)
 
     def body(carry, t):
         cache, toks = carry
         cur = jax.lax.dynamic_slice(toks, (0, t), (b, 1))
         logits, cache = decode_step(model, params, cache, t, cur)
-        lg = logits[:, 0, :].astype(jnp.float32)
-        if temperature > 0:
-            nxt = jax.random.categorical(keys[t], lg / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(lg, axis=-1)
-        nxt = nxt.astype(toks.dtype)[:, None]
-        # Inside the prompt the next token is already known — keep it
-        # (t runs to total-2, so t+1 is always a valid column).
-        keep = jax.lax.dynamic_slice(toks, (0, t + 1), (b, 1))
-        write = jnp.where(t + 1 < plen, keep, nxt)
-        toks = jax.lax.dynamic_update_slice(toks, write, (0, t + 1))
+        nxt = pick(logits[:, 0, :], t)
+        toks = jax.lax.dynamic_update_slice(toks, nxt, (0, t + 1))
         return (cache, toks), None
 
     (_, toks), _ = jax.lax.scan(body, (cache, toks),
-                                jnp.arange(total - 1))
+                                jnp.arange(plen, total - 1))
     return toks

@@ -40,6 +40,8 @@ class Block(nn.Module):
     mesh: Optional[Mesh]
     sp_axis: str
     n_experts: int = 0
+    sow_kv: bool = False  # stash per-layer K/V heads (decode prefill
+    #                       seeds its cache from one full forward)
 
     @nn.compact
     def __call__(self, x):
@@ -54,6 +56,8 @@ class Block(nn.Module):
         to_heads = lambda t: t.reshape(b, s, self.heads, hd).transpose(
             0, 2, 1, 3)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if self.sow_kv:
+            self.sow("intermediates", "kv", (k, v))
         use_sp = (self.mesh is not None
                   and self.mesh.shape.get(self.sp_axis, 1) > 1)
         if use_sp:
@@ -133,6 +137,7 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None   # enables ring attention when sp > 1
     sp_axis: str = "sp"
     n_experts: int = 0            # > 0 swaps the MLP for a switch-MoE
+    sow_kv: bool = False          # blocks stash K/V heads (decode prefill)
     remat: bool = False           # rematerialize blocks (long context:
     #                               trade recompute for activation memory)
     remat_policy: Optional[str] = None  # name of a jax.checkpoint_policies
@@ -167,7 +172,8 @@ class TransformerLM(nn.Module):
         for i in range(self.layers):
             x = block_cls(self.dim, self.heads, self.mlp_ratio,
                           self.compute_dtype, self.mesh, self.sp_axis,
-                          n_experts=self.n_experts, name=f"block{i}")(x)
+                          n_experts=self.n_experts, sow_kv=self.sow_kv,
+                          name=f"block{i}")(x)
         return LMHead(self.vocab, name="lmhead")(x, return_features)
 
 
@@ -183,6 +189,25 @@ def loss_fn(logits, targets):
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
+
+
+def moe_aux_sum(collections) -> jax.Array:
+    """Sum ONLY the sown ``moe_aux`` scalars out of a mutable-collections
+    dict. Summing every intermediates leaf would break the moment any
+    other feature sows tensors (sow_kv does exactly that)."""
+    total = jnp.zeros((), jnp.float32)
+
+    def walk(node):
+        nonlocal total
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "moe_aux":
+                    total = total + sum(jax.tree_util.tree_leaves(v))
+                else:
+                    walk(v)
+
+    walk(collections)
+    return total
 
 
 def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
@@ -213,8 +238,7 @@ def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
     if mutable:
         out, inter = model.apply(params, tokens, positions, fused_xent,
                                  mutable=mutable)
-        aux = MOE_AUX_WEIGHT \
-            * sum(jax.tree_util.tree_leaves(inter)) / model.layers
+        aux = MOE_AUX_WEIGHT * moe_aux_sum(inter) / model.layers
     else:
         out = model.apply(params, tokens, positions, fused_xent)
         aux = 0.0
@@ -449,7 +473,7 @@ def _make_stage_fn(model: "TransformerLM", n_stages: int,
         for j in range(g):
             x, inter = blk.apply({"params": stage_params[f"layer{j}"]}, x,
                                  mutable=("intermediates",))
-            side = side + sum(jax.tree_util.tree_leaves(inter))
+            side = side + moe_aux_sum(inter)
         return x, side / model.layers
 
     return stage_fn_aux if with_aux else stage_fn

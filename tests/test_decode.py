@@ -52,6 +52,14 @@ def test_decode_step_matches_full_forward():
 def test_generate_greedy_matches_naive():
     model = _model()
     params = _params(model)
+    # Perturb the final LayerNorm away from identity: at init (scale=1,
+    # bias=0) LN o LN == LN, which would hide a double-normalization bug
+    # in the prefill head path.
+    lnf = params["params"]["lmhead"]["lnf"]
+    lnf["scale"] = lnf["scale"] + jax.random.uniform(
+        jax.random.key(9), lnf["scale"].shape, minval=0.5, maxval=1.5)
+    lnf["bias"] = jax.random.normal(jax.random.key(10),
+                                    lnf["bias"].shape) * 0.3
     b, plen, new = 2, 5, 6
     prompt = jax.random.randint(jax.random.key(2), (b, plen), 0,
                                 model.vocab)
@@ -159,3 +167,14 @@ def test_grad_accum_rejects_indivisible():
     pos = jnp.tile(jnp.arange(8), (4, 1))
     with pytest.raises(ValueError, match="divisible"):
         step(state, tok, tok, pos)
+
+
+def test_generate_moe_smoke():
+    """MoE generate: prefill rides the training forward (capacity
+    clipping over the prompt), cached steps use dropless routing."""
+    model = _model(n_experts=2)
+    params = _params(model)
+    out = decode.generate(model, params, jnp.zeros((1, 4), jnp.int32), 3)
+    assert out.shape == (1, 7)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out)
+                                      < model.vocab)).all()
