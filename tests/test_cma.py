@@ -145,3 +145,36 @@ def test_cma_hash_never_zero():
 
     assert fnv("") != 0
     assert fnv("data") != 0
+
+
+def _worker_bigread(rank, world, tmp, q):
+    try:
+        os.environ["DDSTORE_CMA"] = "1"
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            # 16 MiB/rank: a whole-shard read crosses the 8 MiB striping
+            # threshold, so the parallel multi-part CMA path serves it.
+            rows, dim = 16384, 128
+            s.add("big", np.full((rows, dim), rank + 1, np.float64))
+            s.barrier()
+            ops = 0
+            if rank == 0:
+                peer = s.get("big", rows, rows)  # rank 1's whole shard
+                assert peer.shape == (rows, dim)
+                assert (peer == 2.0).all()
+                ops = s.cma_ops
+            s.barrier()
+        q.put((rank, None, ops))
+    except BaseException:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc(), 0))
+
+
+def test_cma_striped_big_read(tmp_path):
+    """A >8 MiB read rides the multi-part parallel CMA path; every byte
+    must land (rank-stamp oracle over the full peer shard)."""
+    info = _spawn(2, _worker_bigread, str(tmp_path))
+    if _cma_possible():
+        assert info[0] > 0, f"CMA never engaged ({info})"

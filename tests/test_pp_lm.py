@@ -260,7 +260,7 @@ def test_moe_pp_aux_threaded_both_schedules():
             sl = slice(i * mb, (i + 1) * mb)
             logits, inter = model.apply(params, tokens[sl], positions[sl],
                                         mutable=("intermediates",))
-            aux = sum(jax.tree_util.tree_leaves(inter)) / model.layers
+            aux = transformer.moe_aux_sum(inter) / model.layers
             tot = tot + transformer.loss_fn(logits, targets[sl]) \
                 + 0.01 * aux
         return tot / n_micro
@@ -324,7 +324,7 @@ def test_moe_pp_dp_aux_exact():
                 logits, inter = model.apply(
                     params, tokens[sl], positions[sl],
                     mutable=("intermediates",))
-                aux = sum(jax.tree_util.tree_leaves(inter)) / model.layers
+                aux = transformer.moe_aux_sum(inter) / model.layers
                 tot = tot + (transformer.loss_fn(logits, targets[sl])
                              + 0.01 * aux)
         return tot / (n_micro * ndp)
