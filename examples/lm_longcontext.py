@@ -14,7 +14,10 @@ Run single-process (8 virtual devices, 2×4 dp×sp):
         python examples/lm_longcontext.py --seq 2048 --epochs 2
 
 Multi-process works exactly like the other examples (DDSTORE_RANK/WORLD/
-RDV_DIR env; the store goes over TCP).
+RDV_DIR env; the store goes over TCP). ``--accum-steps N`` trains the
+same effective batch in 1/N the activation memory (gradient
+accumulation); ``--generate N`` ends the run with a KV-cached greedy
+continuation of a training window's prefix (one-pass prompt prefill).
 """
 
 import argparse
