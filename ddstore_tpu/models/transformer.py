@@ -233,6 +233,21 @@ def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
         # >= 2 blocks required: a single-block "fusion" still materializes
         # the full logits tile AND pays the backward recompute.
         fused_xent = model.vocab >= 2 * xent_block and not tp
+        if fused_xent:
+            # The fused head matmul runs in compute_dtype (bf16 by
+            # default) where the unfused Dense head is f32; crossing the
+            # vocab threshold changes head precision between otherwise
+            # identical configs, so say so once instead of silently.
+            global _FUSED_AUTO_LOGGED
+            if not _FUSED_AUTO_LOGGED:
+                _FUSED_AUTO_LOGGED = True
+                import logging
+                logging.getLogger(__name__).info(
+                    "lm_loss: vocab=%d >= %d auto-enables the fused "
+                    "linear+softmax-xent head (matmul in %s, f32 "
+                    "accumulation); pass fused_xent=False for the f32 "
+                    "Dense head", model.vocab, 2 * xent_block,
+                    jnp.dtype(model.compute_dtype).name)
     mutable = ("intermediates",) if model.n_experts > 0 else False
 
     if mutable:
@@ -252,6 +267,10 @@ def lm_loss(model: "TransformerLM", params, tokens, targets, positions, *,
         out.reshape(-1, out.shape[-1]).astype(model.compute_dtype),
         w, targets.reshape(-1), xent_block, model.compute_dtype)
     return nll.mean() + aux
+
+
+# One-shot flag for the fused-xent auto-enable notice (ADVICE r3 #3).
+_FUSED_AUTO_LOGGED = False
 
 
 class TrainState(NamedTuple):

@@ -10,6 +10,7 @@
 #include <algorithm>
 #include <cerrno>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <vector>
@@ -33,6 +34,29 @@ uint64_t CmaHash(const std::string& name) {
   }
   // 0 marks an empty slot, ~0 a tombstone; neither may be a name hash.
   return (h == 0 || h == kCmaTombstone) ? 1 : h;
+}
+
+uint64_t ProcStartTime(int64_t pid) {
+  char path[64];
+  std::snprintf(path, sizeof(path), "/proc/%ld/stat",
+                static_cast<long>(pid));
+  std::ifstream f(path);
+  std::string line;
+  if (!std::getline(f, line)) return 0;
+  // comm (field 2) is "(...)" and may itself contain spaces/parens;
+  // everything after the LAST ')' is well-formed space-separated fields
+  // starting at field 3 (state). starttime is field 22 -> 20th token.
+  size_t close = line.rfind(')');
+  if (close == std::string::npos) return 0;
+  const char* p = line.c_str() + close + 1;
+  int field = 2;
+  while (*p && field < 21) {
+    while (*p == ' ') ++p;
+    while (*p && *p != ' ') ++p;
+    ++field;
+  }
+  while (*p == ' ') ++p;
+  return *p ? std::strtoull(p, nullptr, 10) : 0;
 }
 
 std::string CmaHostToken() {
@@ -71,17 +95,25 @@ CmaRegistry::CmaRegistry() {
   seg_ = static_cast<CmaSegment*>(p);
   std::memset(seg_, 0, sizeof(CmaSegment));
   seg_->pid = ::getpid();
-  // Under Yama ptrace_scope=1 (common default) sibling processes get
-  // EPERM from process_vm_readv; opt this process into being readable by
-  // any same-uid peer. Best effort — scope>=2 still (correctly) demotes
-  // peers to TCP via the probe.
-#ifdef PR_SET_PTRACER
-  ::prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
-#endif
+  seg_->start_time = ProcStartTime(::getpid());
   // magic last: a reader that maps mid-init sees magic==0 and rejects.
   __atomic_store_n(&seg_->magic, kCmaMagic, __ATOMIC_RELEASE);
   shm_name_ = name;
   fd_ = fd;
+}
+
+void CmaRegistry::EnableReads() {
+  std::call_once(reads_enabled_, [] {
+    // Under Yama ptrace_scope=1 (common default) sibling processes get
+    // EPERM from process_vm_readv; opt this process into being readable
+    // by any same-uid peer. Best effort — scope>=2 still (correctly)
+    // demotes peers to TCP via the probe. Process-wide and permanent,
+    // which is why it waits for a peer to actually ask (kOpCmaInfo)
+    // rather than running at construction.
+#ifdef PR_SET_PTRACER
+    ::prctl(PR_SET_PTRACER, PR_SET_PTRACER_ANY, 0, 0, 0);
+#endif
+  });
 }
 
 CmaRegistry::~CmaRegistry() {
@@ -138,7 +170,8 @@ void CmaRegistry::Unpublish(const std::string& name) {
   s->gen.fetch_add(1, std::memory_order_acq_rel);
 }
 
-CmaPeer* CmaPeer::Open(const std::string& shm_name, int64_t pid) {
+CmaPeer* CmaPeer::Open(const std::string& shm_name, int64_t pid,
+                       uint64_t start_time) {
   if (shm_name.empty() || shm_name.find('/') != std::string::npos)
     return nullptr;
   std::string path = std::string(kShmDir) + "/" + shm_name;
@@ -149,12 +182,24 @@ CmaPeer* CmaPeer::Open(const std::string& shm_name, int64_t pid) {
   ::close(fd);  // the mapping keeps the segment alive
   if (p == MAP_FAILED) return nullptr;
   auto* seg = static_cast<CmaSegment*>(p);
+  // Three-way identity check: the segment must have been created by the
+  // advertised (pid, starttime), and that pid must STILL be that process
+  // per the live /proc entry — a stale segment whose pid was recycled to
+  // an unrelated process fails here instead of being read.
   if (__atomic_load_n(&seg->magic, __ATOMIC_ACQUIRE) != kCmaMagic ||
-      seg->pid != pid) {
+      seg->pid != pid || start_time == 0 ||
+      seg->start_time != start_time ||
+      ProcStartTime(pid) != start_time) {
     ::munmap(p, sizeof(CmaSegment));
     return nullptr;
   }
-  return new CmaPeer(seg, sizeof(CmaSegment), pid);
+  return new CmaPeer(seg, sizeof(CmaSegment), pid, start_time);
+}
+
+bool CmaPeer::PeerStillAlive() {
+  if (ProcStartTime(pid_) == start_time_) return true;
+  denied_.store(true, std::memory_order_relaxed);
+  return false;
 }
 
 CmaPeer::~CmaPeer() {
@@ -164,6 +209,12 @@ CmaPeer::~CmaPeer() {
 int CmaPeer::TryReadV(const std::string& name, const ReadOp* ops,
                       int64_t n) {
   if (denied_.load(std::memory_order_relaxed)) return kCmaFallback;
+  // Cheap periodic liveness recheck (pid-recycle guard): once every 4096
+  // calls, confirm the pid still belongs to the segment's creator.
+  if ((reads_since_check_.fetch_add(1, std::memory_order_relaxed) &
+       4095) == 4095 &&
+      !PeerStillAlive())
+    return kCmaFallback;
   const uint64_t h = CmaHash(name);
   // Reader-side probe mirrors FindSlot.
   CmaSlot* slot = nullptr;
@@ -225,7 +276,13 @@ int CmaPeer::TryReadV(const std::string& name, const ReadOp* ops,
       // else: generation bounced or mapping went away mid-read — the
       // bytes may be garbage; retry, then fall back.
     }
-    if (!done) return kCmaFallback;
+    if (!done) {
+      // A failed read is the moment a recycled pid would first show up
+      // (the old mapping's addresses usually aren't valid in the new
+      // process): revalidate so a dead peer demotes to TCP permanently.
+      PeerStillAlive();
+      return kCmaFallback;
+    }
     begin = end;
   }
   return kOk;

@@ -55,6 +55,12 @@ struct CmaSlot {
 struct CmaSegment {
   uint64_t magic;
   int64_t pid;
+  // Creator's /proc/<pid>/stat starttime (clock ticks since boot). pid
+  // alone is recyclable: a crashed peer's segment can outlive it in
+  // /dev/shm, and the OS may hand the pid to an unrelated same-uid
+  // process whose address space process_vm_readv would then happily (and
+  // wrongly) read. pid + starttime is unique for the boot.
+  uint64_t start_time;
   CmaSlot slots[kCmaSlots];
 };
 
@@ -66,6 +72,10 @@ uint64_t CmaHash(const std::string& name);
 // a boot_id but cannot process_vm_readv each other — the probe settles it).
 std::string CmaHostToken();
 
+// starttime (field 22 of /proc/<pid>/stat) for `pid`; 0 if unreadable.
+// Parsing skips past the last ')' — comm may contain spaces and parens.
+uint64_t ProcStartTime(int64_t pid);
+
 // Publisher side: owns a /dev/shm segment advertising this process's
 // variable mappings.
 class CmaRegistry {
@@ -75,6 +85,12 @@ class CmaRegistry {
 
   bool ok() const { return seg_ != nullptr; }
   const std::string& shm_name() const { return shm_name_; }
+
+  // Relax Yama ptrace protection so same-uid peers can process_vm_readv
+  // this process. Deferred until a peer actually asks for our CMA info
+  // (the kOpCmaInfo handler) instead of done unconditionally at startup:
+  // a store whose peers are all cross-host never needs the relaxation.
+  void EnableReads();
 
   // Seqlock-publish {base, len} for `name` (new slot or in-place rebind).
   void Publish(const std::string& name, const void* base, int64_t len);
@@ -88,6 +104,7 @@ class CmaRegistry {
   CmaSegment* seg_ = nullptr;
   std::string shm_name_;
   int fd_ = -1;
+  std::once_flag reads_enabled_;
 };
 
 // Reader side: a peer's mapped segment + pid.
@@ -95,8 +112,12 @@ class CmaPeer {
  public:
   ~CmaPeer();
 
-  // Maps `shm_name` and validates magic/pid. nullptr on any failure.
-  static CmaPeer* Open(const std::string& shm_name, int64_t pid);
+  // Maps `shm_name` and validates magic, pid AND the creator's starttime
+  // against both the segment header and the live /proc entry, so a
+  // recycled pid (crashed peer, stale segment) is rejected instead of
+  // read. nullptr on any failure.
+  static CmaPeer* Open(const std::string& shm_name, int64_t pid,
+                       uint64_t start_time);
 
   // Try to serve `ops` via process_vm_readv. Returns:
   //   kOk          — all bytes read under a stable generation
@@ -110,12 +131,20 @@ class CmaPeer {
   bool denied() const { return denied_.load(std::memory_order_relaxed); }
 
  private:
-  CmaPeer(CmaSegment* seg, size_t map_len, int64_t pid)
-      : seg_(seg), map_len_(map_len), pid_(pid) {}
+  CmaPeer(CmaSegment* seg, size_t map_len, int64_t pid, uint64_t start)
+      : seg_(seg), map_len_(map_len), pid_(pid), start_time_(start) {}
+
+  // Re-check that pid_ still belongs to the process that created the
+  // segment (periodically and on any read failure): if the peer died and
+  // the pid was recycled mid-session, reads must demote to TCP, not
+  // return another process's memory.
+  bool PeerStillAlive();
 
   CmaSegment* seg_;
   size_t map_len_;
   int64_t pid_;
+  uint64_t start_time_;
+  std::atomic<int64_t> reads_since_check_{0};
   std::atomic<bool> denied_{false};
 };
 

@@ -165,6 +165,26 @@ class TcpTransport : public Transport {
   std::unique_ptr<CmaRegistry> cma_reg_;
   std::atomic<int64_t> cma_ops_{0};
 
+  // Adaptive bulk routing. process_vm_readv normally beats sockets for
+  // bulk same-host reads (one kernel copy, no framing), but sandboxed
+  // kernels can emulate it far below socket speed; rather than trust
+  // either assumption, measure both paths and route bulk (>= 8 MiB)
+  // reads down the faster one. Small reads always prefer CMA (it wins on
+  // latency wherever process_vm_readv works at all). One estimate per
+  // transport, not per peer: the decision only matters on same-host
+  // peers, which all share one kernel. Guarded by route_mu_.
+  std::mutex route_mu_;
+  double cma_bulk_bw_ = 0.0;  // EWMA bytes/s; 0 = no sample yet
+  double tcp_bulk_bw_ = 0.0;
+  int64_t bulk_decisions_ = 0;
+  bool bulk_via_tcp_ = false;
+
+  // Decide the path for one bulk request (and advance the probe counter).
+  bool RouteBulkViaTcp();
+  // Fold a measured (bytes, seconds) bulk sample into one path's EWMA and
+  // re-evaluate the preference, logging any crossover.
+  void RecordBulkSample(bool via_tcp, int64_t bytes, double secs);
+
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,
   // so matching uses barrier_seq_ — the transport's own strictly-

@@ -178,3 +178,62 @@ def test_cma_striped_big_read(tmp_path):
     info = _spawn(2, _worker_bigread, str(tmp_path))
     if _cma_possible():
         assert info[0] > 0, f"CMA never engaged ({info})"
+
+
+def _worker_routing(rank, world, tmp, q, bulk_env):
+    try:
+        os.environ["DDSTORE_CMA"] = "1"
+        if bulk_env is not None:
+            os.environ["DDSTORE_CMA_BULK"] = bulk_env
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            rows, dim = 16384, 128  # 16 MiB/rank: over the bulk threshold
+            s.add("big", np.full((rows, dim), rank + 1, np.float64))
+            s.barrier()
+            trace = []
+            if rank == 0:
+                for _ in range(4):
+                    before = s.cma_ops
+                    peer = s.get("big", rows, rows)
+                    assert (peer == 2.0).all()
+                    trace.append(s.cma_ops > before)
+                # Small reads prefer CMA regardless of the bulk policy.
+                before = s.cma_ops
+                assert (s.get("big", rows + 5)[0] == 2.0).all()
+                trace.append(s.cma_ops > before)
+            s.barrier()
+        q.put((rank, None, trace))
+    except BaseException:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc(), []))
+
+
+@pytest.mark.skipif(not _cma_possible(),
+                    reason="yama ptrace_scope >= 2 forbids CMA")
+def test_bulk_routing_forced_tcp(tmp_path):
+    """DDSTORE_CMA_BULK=0: bulk reads ride TCP, small gets still CMA."""
+    info = _spawn(2, _worker_routing, str(tmp_path), ("0",))
+    assert info[0] == [False, False, False, False, True], info[0]
+
+
+@pytest.mark.skipif(not _cma_possible(),
+                    reason="yama ptrace_scope >= 2 forbids CMA")
+def test_bulk_routing_forced_cma(tmp_path):
+    """DDSTORE_CMA_BULK=1 pins every bulk read to the CMA path."""
+    info = _spawn(2, _worker_routing, str(tmp_path), ("1",))
+    assert info[0] == [True, True, True, True, True], info[0]
+
+
+@pytest.mark.skipif(not _cma_possible(),
+                    reason="yama ptrace_scope >= 2 forbids CMA")
+def test_bulk_routing_adaptive_samples_both(tmp_path):
+    """Default (adaptive) routing: the first bulk read samples CMA, the
+    second samples TCP, then the measured-faster path serves the rest.
+    Only the first two are deterministic; the steady-state choice is
+    whatever this box measures faster (that's the point)."""
+    info = _spawn(2, _worker_routing, str(tmp_path), (None,))
+    assert info[0][0] is True, info[0]   # sample CMA
+    assert info[0][1] is False, info[0]  # sample TCP
+    assert info[0][4] is True, info[0]   # small get -> CMA always
