@@ -1,6 +1,7 @@
 """Dataset adapters and device-feeding loaders over the store."""
 
 from .dataset import DistributedSampler, ShardedDataset, nsplit
+from .permute import FeistelPermutation
 from .formats import (find_mnist, load_mnist, load_qm9_dir,
                       molecule_to_graph, read_idx, read_xyz, write_idx,
                       write_xyz)
@@ -11,6 +12,7 @@ from .ragged import (pack_ragged, pad_ragged, segment_ids_from_lengths,
                      split_ragged)
 
 __all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader", "nsplit",
+           "FeistelPermutation",
            "pad_ragged", "pack_ragged", "split_ragged",
            "segment_ids_from_lengths", "GraphBatch", "GraphSample",
            "GraphShardedDataset", "pack_graph_batch", "synthetic_graphs",

@@ -93,13 +93,29 @@ class DistributedSampler:
     draws indices r, r+world, ... of a seeded permutation, padded by
     wrapping so every rank yields the same count (the property the
     reference leans on torch's DistributedSampler for — equal batch counts
-    keep its collective fences aligned, SURVEY §3.3)."""
+    keep its collective fences aligned, SURVEY §3.3).
+
+    Memory: the permutation is a Feistel bijection evaluated on demand in
+    ``block``-sized chunks — a 1e9-row epoch iterates in O(block) memory
+    instead of materializing 8 GB of indices per rank (VERDICT r3 weak
+    #5). ``mode="dense"`` keeps the materialized ``np.permutation`` path
+    (byte-compatible with round-3 orders) and is the default below
+    ``DENSE_MAX`` rows, where the array is cheap and Fisher–Yates mixing
+    is marginally better.
+    """
+
+    # 16M rows = 128 MB of int64 — fine to hold (shared policy constant
+    # with the global shuffles, see data/permute.py).
+    from .permute import DENSE_MAX as DENSE_MAX
 
     def __init__(self, total: int, world: int, rank: int,
                  shuffle: bool = True, seed: int = 0,
-                 drop_last: bool = False):
+                 drop_last: bool = False, mode: str = "auto",
+                 block: int = 1 << 20):
         if not 0 <= rank < world:
             raise ValueError("rank out of range")
+        if mode not in ("auto", "dense", "streamed"):
+            raise ValueError(f"unknown mode: {mode!r}")
         self.total = total
         self.world = world
         self.rank = rank
@@ -107,6 +123,8 @@ class DistributedSampler:
         self.seed = seed
         self.drop_last = drop_last
         self.epoch = 0
+        self.block = block
+        self.mode = mode
         if drop_last:
             self.num_samples = total // world
         else:
@@ -118,7 +136,32 @@ class DistributedSampler:
     def __len__(self) -> int:
         return self.num_samples
 
+    def _streamed(self) -> bool:
+        return self.mode == "streamed" or (self.mode == "auto"
+                                           and self.total > self.DENSE_MAX)
+
+    def _perm(self):
+        from .permute import FeistelPermutation
+        return FeistelPermutation(self.total, (self.seed, self.epoch))
+
+    def _stream_blocks(self, start: int, stop: int):
+        """This rank's indices for global positions [start, stop), in
+        O(block) memory. Position p maps to perm(p % total) — identical
+        wrap-padding semantics to the dense path's np.resize tiling."""
+        perm = self._perm() if self.shuffle else None
+        for lo in range(start, stop, self.block * self.world):
+            hi = min(stop, lo + self.block * self.world)
+            pos = np.arange(lo + self.rank, hi, self.world,
+                            dtype=np.int64) % self.total
+            yield perm(pos) if perm is not None else pos
+
     def __iter__(self):
+        if self._streamed():
+            def gen():
+                for chunk in self._stream_blocks(
+                        0, self.num_samples * self.world):
+                    yield from chunk.tolist()
+            return gen()
         if self.shuffle:
             g = np.random.default_rng((self.seed, self.epoch))
             order = g.permutation(self.total)
@@ -134,5 +177,10 @@ class DistributedSampler:
 
     def epoch_indices(self) -> np.ndarray:
         """This rank's full epoch as one array (for batched fetching)."""
+        if self._streamed():
+            chunks = list(self._stream_blocks(
+                0, self.num_samples * self.world))
+            return np.concatenate(chunks) if chunks else \
+                np.empty((0,), np.int64)
         return np.fromiter(iter(self), dtype=np.int64,
                            count=self.num_samples)

@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .transformer import EmbedPE, LMHead, TransformerLM
 
@@ -43,11 +44,21 @@ def init_cache(model: TransformerLM, batch: int, max_len: int) -> Cache:
 
 
 def decode_step(model: TransformerLM, params, cache: Cache, pos,
-                tokens) -> Tuple[jax.Array, Cache]:
+                tokens, *, slot=None,
+                live_mask: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, Cache]:
     """One incremental step: ``tokens`` (B, 1) at position ``pos`` (a
-    traced scalar is fine) -> (logits (B, 1, V), updated cache).
+    traced scalar — or a (B,) array of PER-ROW positions for padded
+    variable-length batches) -> (logits (B, 1, V), updated cache).
 
-    ``pos`` must be < the cache's ``max_len`` — a concrete out-of-range
+    ``slot`` is the cache slot written this step; it defaults to ``pos``
+    and must be a scalar (every row writes the same slot — with per-row
+    positions, callers pass the uniform buffer slot and per-row
+    ``live_mask``). ``live_mask`` (B, max_len) overrides the default
+    "slots <= pos are attendable" rule, which is how padded prompts keep
+    their dead padding slots invisible forever.
+
+    ``slot`` must be < the cache's ``max_len`` — a concrete out-of-range
     value raises; a traced one is the caller's contract (generate never
     violates it). The layer math is deliberately written against the
     training param subtrees rather than refactoring Block around a cache
@@ -66,21 +77,28 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
     b = tokens.shape[0]
     hd = model.dim // model.heads
     max_len = cache["k"].shape[3]
-    if not isinstance(pos, jax.core.Tracer):
-        ipos = int(pos)
-        if ipos < 0 or ipos >= max_len:
-            raise ValueError(f"pos {ipos} outside cache [0, {max_len}): "
+    if slot is None:
+        slot = pos
+    if not isinstance(slot, jax.core.Tracer):
+        islot = int(slot)
+        if islot < 0 or islot >= max_len:
+            raise ValueError(f"slot {islot} outside cache [0, {max_len}): "
                              "dynamic_update_slice would silently clamp "
                              "and corrupt a boundary slot")
     scale = 1.0 / math.sqrt(hd)
 
-    positions = jnp.full((b, 1), pos, jnp.int32)
+    positions = jnp.broadcast_to(jnp.asarray(pos, jnp.int32),
+                                 (b,))[:, None]
     x = EmbedPE(model.vocab, model.dim, dt).apply(
         {"params": p["embed"]}, tokens, positions)
 
     ln = nn.LayerNorm(dtype=jnp.float32)
-    # Same slot mask for every layer: cache positions <= pos are live.
-    live = (jnp.arange(max_len) <= pos)[None, None, None, :]
+    # Slot mask, same for every layer: by default cache slots <= slot are
+    # live; a caller-supplied (B, max_len) mask handles padded batches.
+    if live_mask is None:
+        live = (jnp.arange(max_len) <= slot)[None, None, None, :]
+    else:
+        live = live_mask[:, None, None, :]
     # Update the stacked 5-D cache in place (dynamic_update_slice on the
     # scan carry — XLA aliases it; a per-layer slice + stack would copy
     # the whole cache every generated token).
@@ -95,9 +113,9 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
             0, 2, 1, 3)  # (B, H, 1, hd)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         ck_all = jax.lax.dynamic_update_slice(ck_all, k[None],
-                                              (i, 0, 0, pos, 0))
+                                              (i, 0, 0, slot, 0))
         cv_all = jax.lax.dynamic_update_slice(cv_all, v[None],
-                                              (i, 0, 0, pos, 0))
+                                              (i, 0, 0, slot, 0))
 
         s = jnp.einsum("bhqd,bhkd->bhqk", q, ck_all[i],
                        preferred_element_type=jnp.float32) * scale
@@ -140,20 +158,71 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
     return logits, {"k": ck_all, "v": cv_all}
 
 
+def filter_logits(lg: jax.Array, top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jax.Array:
+    """Nucleus / top-k filtering of (B, V) f32 logits: everything
+    outside the kept set goes to -inf, so sampling never picks it.
+
+    top_k keeps the k highest-logit tokens per row. top_p (nucleus)
+    keeps the smallest prefix of the probability-sorted vocabulary whose
+    mass reaches p (the highest-probability token always survives, so
+    the distribution can never become empty). Both may be combined; the
+    masks intersect."""
+    lg = lg.astype(jnp.float32)
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+        lg = jnp.where(lg < kth, NEG_INF, lg)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_p is not None and top_p < 1.0:
+        # (top_p == 1.0 is the identity; running it through the cumsum
+        # would drop tokens whose probability rounds below f32 eps.)
+        sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        # exclusive cumulative mass BEFORE each token: the first token
+        # whose preceding mass already reaches p is the first dropped.
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = cum < top_p
+        # Per-row threshold logit: the smallest logit still kept.
+        thresh = jnp.min(jnp.where(keep_sorted, sorted_lg, jnp.inf),
+                         axis=-1, keepdims=True)
+        lg = jnp.where(lg < thresh, NEG_INF, lg)
+    return lg
+
+
 def generate(model: TransformerLM, params, prompt: jax.Array,
              max_new_tokens: int, temperature: float = 0.0,
-             key: Optional[jax.Array] = None) -> jax.Array:
+             key: Optional[jax.Array] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             prompt_lengths: Optional[jax.Array] = None,
+             prefill_mesh=None) -> jax.Array:
     """Autoregressive continuation of ``prompt`` (B, P) int32.
 
     Returns (B, P + max_new_tokens). ``temperature == 0`` is greedy;
-    otherwise samples from softmax(logits / temperature) using ``key``.
-    The prompt prefills in ONE full forward pass (the blocks ``sow``
-    their K/V heads, which seed the cache) — O(1) sequential steps for
-    the prompt instead of O(P) — then a ``lax.scan`` of cached steps
-    decodes the new tokens. Shapes are static: each distinct (prompt
-    length, max_new_tokens) pair compiles once — callers serving
-    variable-length prompts should pad them to a fixed length to avoid
-    per-length recompiles.
+    otherwise samples from softmax(logits / temperature) using ``key``,
+    optionally filtered by ``top_k`` / ``top_p`` (nucleus) — see
+    :func:`filter_logits`. The prompt prefills in ONE full forward pass
+    (the blocks ``sow`` their K/V heads, which seed the cache) — O(1)
+    sequential steps for the prompt instead of O(P) — then a
+    ``lax.scan`` of cached steps decodes the new tokens. Shapes are
+    static: each distinct (prompt length, max_new_tokens) pair compiles
+    once.
+
+    **Variable-length batches**: pass right-padded prompts plus
+    ``prompt_lengths`` (B,) — row b's real tokens are
+    ``prompt[b, :len_b]``; the pad values are arbitrary. Their cache
+    slots are masked dead forever, every row's generated token j is
+    embedded at ITS position ``len_b + j``, and all rows' new tokens
+    land in slots/columns ``[P, P + max_new_tokens)``. Row b's full
+    sequence is ``prompt[b, :len_b] ++ out[b, P:]``.
+
+    **Long prompts**: ``prefill_mesh`` runs the one-pass prefill with
+    the model's ring attention over that mesh's ``sp`` axis (sequence
+    sharded, K/V rotating over ICI), for prompts a single device's
+    memory can't hold; the decode scan itself stays data-parallel.
     """
     if temperature > 0 and key is None:
         raise ValueError("sampling (temperature > 0) needs `key`")
@@ -166,9 +235,24 @@ def generate(model: TransformerLM, params, prompt: jax.Array,
     total = plen + max_new_tokens
     cache = init_cache(model, b, total)
     keys = jax.random.split(key, total) if temperature > 0 else None
+    if prompt_lengths is not None:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        if lengths.shape != (b,):
+            raise ValueError(f"prompt_lengths shape {lengths.shape} != "
+                             f"({b},)")
+        if not isinstance(lengths, jax.core.Tracer):
+            lv = np.asarray(lengths)
+            if (lv < 1).any() or (lv > plen).any():
+                # 0 would make (lengths-1) clamp to the wrong feature
+                # and > plen would mark phantom columns live — garbage
+                # continuations with no error.
+                raise ValueError(f"prompt_lengths must be in [1, {plen}]"
+                                 f", got {lv.tolist()}")
+    else:
+        lengths = None
 
     def pick(lg, t):
-        lg = lg.astype(jnp.float32)
+        lg = filter_logits(lg, top_k, top_p)
         if temperature > 0:
             nxt = jax.random.categorical(keys[t], lg / temperature,
                                          axis=-1)
@@ -185,7 +269,7 @@ def generate(model: TransformerLM, params, prompt: jax.Array,
     # for MoE models the prefill applies TRAINING routing (capacity
     # clipping over the whole prompt), then cached steps are dropless —
     # the same train/infer asymmetry decode_step documents.
-    pm = model.clone(mesh=None, remat=False, sow_kv=True)
+    pm = model.clone(mesh=prefill_mesh, remat=False, sow_kv=True)
     positions = jnp.tile(jnp.arange(plen, dtype=jnp.int32), (b, 1))
     feats, inter = pm.apply(params, prompt, positions, True,
                             mutable=("intermediates",))
@@ -201,21 +285,39 @@ def generate(model: TransformerLM, params, prompt: jax.Array,
     # feats are already post-lnf (features_only applies the LayerNorm);
     # apply ONLY the vocab projection — LMHead.apply here would LayerNorm
     # a second time, invisible at init (scale=1, bias=0 makes LN o LN a
-    # no-op) but wrong for any trained model.
+    # no-op) but wrong for any trained model. With per-row lengths the
+    # first new token conditions on each row's LAST REAL position (the
+    # padding features beyond it are causal garbage and never read).
     w = params["params"]["lmhead"]["head"]["kernel"]
-    last_logits = feats[:, -1, :].astype(jnp.float32) @ w.astype(
-        jnp.float32)
+    last_feats = feats[:, -1, :] if lengths is None else \
+        jnp.take_along_axis(feats, (lengths - 1)[:, None, None],
+                            axis=1)[:, 0, :]
+    last_logits = last_feats.astype(jnp.float32) @ w.astype(jnp.float32)
     first = pick(last_logits, plen - 1)
     toks = jnp.concatenate(
         [prompt, first, jnp.zeros((b, max_new_tokens - 1), prompt.dtype)],
         axis=1)
+    col = jnp.arange(total)
+    prompt_live = None if lengths is None else col[None, :] < \
+        lengths[:, None]
 
-    def body(carry, t):
+    def body(carry, s):
+        # Cache slot s holds the token at column s for EVERY row; with
+        # per-row lengths its embedded position is the row's own
+        # lengths + (s - plen), and dead padding slots [len_b, plen)
+        # stay masked out of attention forever.
         cache, toks = carry
-        cur = jax.lax.dynamic_slice(toks, (0, t), (b, 1))
-        logits, cache = decode_step(model, params, cache, t, cur)
-        nxt = pick(logits[:, 0, :], t)
-        toks = jax.lax.dynamic_update_slice(toks, nxt, (0, t + 1))
+        cur = jax.lax.dynamic_slice(toks, (0, s), (b, 1))
+        if lengths is None:
+            logits, cache = decode_step(model, params, cache, s, cur)
+        else:
+            pos = lengths + (s - plen)
+            live = prompt_live | ((col[None, :] >= plen)
+                                  & (col[None, :] <= s))
+            logits, cache = decode_step(model, params, cache, pos, cur,
+                                        slot=s, live_mask=live)
+        nxt = pick(logits[:, 0, :], s)
+        toks = jax.lax.dynamic_update_slice(toks, nxt, (0, s + 1))
         return (cache, toks), None
 
     (_, toks), _ = jax.lax.scan(body, (cache, toks),

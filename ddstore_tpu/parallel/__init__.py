@@ -12,7 +12,9 @@ from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
 from .pipeline import (pipeline_1f1b, pipeline_apply,
                        stack_stage_params)
 from .ring_attention import ring_attention, ring_self_attention
-from .shuffle import all_to_all_rows, global_shuffle_epoch, permute_rows
+from .shuffle import (all_to_all_rows, global_shuffle_epoch,
+                      host_global_shuffle, permute_rows,
+                      ragged_global_shuffle)
 from .tp import expert_rules, megatron_rules, shard_pytree, shardings_of
 
 __all__ = [
@@ -24,6 +26,8 @@ __all__ = [
     "all_to_all_rows",
     "permute_rows",
     "global_shuffle_epoch",
+    "host_global_shuffle",
+    "ragged_global_shuffle",
     "ring_attention",
     "ring_self_attention",
     "fsdp_rules",

@@ -23,11 +23,11 @@ actually train large models with.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["fsdp_rules"]
+__all__ = ["fsdp_rules", "fsdp_compose"]
 
 
 def fsdp_rules(mesh: Mesh, axis: str = "fsdp") -> Callable:
@@ -60,6 +60,38 @@ def fsdp_rules(mesh: Mesh, axis: str = "fsdp") -> Callable:
             return P()
         spec = [None] * len(shape)
         spec[best] = axis
+        return P(*spec)
+
+    return rules
+
+
+def fsdp_compose(base_rules: Optional[Callable], mesh: Mesh,
+                 axis: str = "fsdp") -> Callable:
+    """Layer ZeRO-3 sharding ON TOP of another rule set (fsdp×tp /
+    fsdp×ep — VERDICT r3 missing #1 replaced a hard refusal at
+    transformer.py's create_train_state with this).
+
+    Per leaf: take the base spec (megatron / expert rules), then shard
+    the largest base-unsharded dimension divisible by the fsdp axis size
+    over ``axis``. A leaf with no such dimension keeps just its base
+    spec — replication across fsdp of a tp-sharded leaf still holds
+    1/tp of it per device. The head kernel needs no special case here:
+    megatron already shards its vocab dim over tp (which disables the
+    fused-xent path), and fsdp then takes the feature dim.
+    """
+    size = mesh.shape[axis]
+
+    def rules(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        base = tuple(base_rules(path, leaf)) if base_rules else ()
+        spec = list(base) + [None] * (len(shape) - len(base))
+        best = None
+        for i, d in enumerate(shape):
+            if spec[i] is None and d % size == 0 and d >= size:
+                if best is None or d > shape[best]:
+                    best = i
+        if best is not None:
+            spec[best] = axis
         return P(*spec)
 
     return rules

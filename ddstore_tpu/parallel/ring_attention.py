@@ -50,10 +50,14 @@ def _combine(acc_out, acc_lse, out_i, lse_i):
     return out, lse
 
 
-def _ring_body(q, k, v, *, axis: str, n: int, causal: bool,
+def _ring_body(q, k, v, idx_chunk, *, axis: str, n: int, causal: bool,
                use_flash: bool):
-    """shard_map body: local chunks (B, H, S/n, D)."""
-    idx = jax.lax.axis_index(axis)
+    """shard_map body: local chunks (B, H, S/n, D). ``idx_chunk`` is this
+    device's slice of an arange over the ring axis — the ring position.
+    NOT ``jax.lax.axis_index``: its lowering computes the position from
+    the full device id, which re-binds every mesh axis and breaks when
+    this shard_map is nested inside another manual region (pp×sp)."""
+    idx = idx_chunk[0]
     sq, sk = q.shape[2], k.shape[2]
     q_off = idx * sq
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -111,6 +115,23 @@ def _ring_body(q, k, v, *, axis: str, n: int, causal: bool,
     return acc_out.astype(q.dtype), acc_lse
 
 
+@functools.lru_cache(maxsize=64)
+def _eager_ring(mesh, bspec, hspec, axis, n, causal, use_flash):
+    """Jitted ring shard_map for EAGER callers, cached on everything the
+    trace depends on (shapes re-key inside jax.jit itself)."""
+    body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
+                             use_flash=use_flash)
+    spec = P(bspec, hspec, axis, None)
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, P(axis)),
+        out_specs=(spec, P(bspec, hspec, axis)),
+        axis_names=frozenset(a for a in (axis, bspec, hspec)
+                             if a is not None),
+        check_vma=False,
+    ))
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    mesh: Mesh, axis: str = "sp", causal: bool = False,
                    batch_axis: Optional[str] = None,
@@ -136,6 +157,28 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         else None
     hspec = heads_axis if (heads_axis
                            and mesh.shape.get(heads_axis, 1) > 1) else None
+    # Nesting (pp×sp): when called from inside another shard_map (e.g. a
+    # pipeline stage manual over pp/dp), the inner shard_map must use the
+    # CONTEXT abstract mesh, and axes that context already split manually
+    # (dp inside the pipeline body) must drop out of the specs — the
+    # arrays in hand are already local chunks along them.
+    sm_mesh = mesh
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and not ctx.empty:
+        Manual = jax.sharding.AxisType.Manual
+        already = {name for name, t in zip(ctx.axis_names, ctx.axis_types)
+                   if t == Manual}
+        if already:
+            if axis in already:
+                raise ValueError(
+                    f"ring axis {axis!r} is already manual in the "
+                    f"enclosing shard_map; ring attention cannot re-split "
+                    f"it")
+            sm_mesh = ctx
+            if bspec in already:
+                bspec = None
+            if hspec in already:
+                hspec = None
     spec = P(bspec, hspec, axis, None)
     sq, sk = q.shape[2] // n, k.shape[2] // n
     if impl == "auto":
@@ -155,12 +198,28 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return mha_reference(q, k, v, causal=causal)
     body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
                              use_flash=use_flash)
-    return jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=(spec, P(bspec, hspec, axis)),
-        check_vma=False,
-    )(q, k, v)
+    # Partial-manual: only the axes the ring actually uses are manual;
+    # anything else (tp on the head dim, fsdp on params upstream) stays
+    # with the compiler so the two compose.
+    if isinstance(q, jax.core.Tracer):
+        fn = jax.shard_map(
+            body, mesh=sm_mesh,
+            in_specs=(spec, spec, spec, P(axis)),
+            out_specs=(spec, P(bspec, hspec, axis)),
+            axis_names=frozenset(a for a in (axis, bspec, hspec)
+                                 if a is not None),
+            check_vma=False,
+        )
+    else:
+        # Partial-manual shard_map (axis_names ⊂ mesh axes) only lowers
+        # correctly under jit in current JAX — the eager path trips a
+        # bogus "out_specs refers to <other axis>" check. Production
+        # calls are always inside a jitted step; this keeps direct eager
+        # use (model.init with a mesh-carrying model, notebooks) working
+        # — through a CACHED jit wrapper, or a fresh jax.jit per call
+        # would recompile every invocation.
+        fn = _eager_ring(sm_mesh, bspec, hspec, axis, n, causal, use_flash)
+    return fn(q, k, v, jnp.arange(n, dtype=jnp.int32))
 
 
 def ring_self_attention(x_heads, *, mesh: Mesh, axis: str = "sp",

@@ -88,6 +88,33 @@ def permute_rows(x: jax.Array, perm: jax.Array, mesh: Mesh,
     return jax.lax.with_sharding_constraint(taken, sharding)
 
 
+def _shard_perm(total: int, begin: int, end: int, seed,
+                rng: Optional[np.random.Generator]) -> np.ndarray:
+    """perm[begin:end] of a seeded global permutation, O(end - begin)
+    memory when total is large (every rank computes the SAME perm) —
+    the dense-vs-Feistel policy lives in data/permute.py."""
+    from ..data.permute import seeded_perm_slice
+    return seeded_perm_slice(total, begin, end, seed, rng)
+
+
+def _reject_ragged(store, name: str) -> None:
+    """A ragged pair's {name}/index rows carry (values_start, length)
+    pointers whose spans live in the SAME rank's values shard
+    (store.add_ragged's locality invariant). Row-shuffling either half
+    independently silently corrupts that invariant — index rows pointing
+    at spans that moved, or values rows torn out of their samples. Route
+    callers to ragged_global_shuffle, which moves spans with their rows."""
+    base = name.rsplit("/", 1)[0] if "/" in name else name
+    if name.endswith(("/index", "/values")) and store.is_ragged(base):
+        raise ValueError(
+            f"{name} is half of the ragged pair {base!r}; shuffling it "
+            f"alone would corrupt the index->values locality invariant. "
+            f"Use ragged_global_shuffle(store, {base!r}, seed).")
+    if store.is_ragged(name):
+        raise ValueError(
+            f"{name} is a ragged variable; use ragged_global_shuffle.")
+
+
 def host_global_shuffle(store, name: str, seed: int,
                         rng: Optional[np.random.Generator] = None) -> None:
     """Host-path global shuffle of a store variable, in place.
@@ -96,15 +123,37 @@ def host_global_shuffle(store, name: str, seed: int,
     the rows assigned to its shard (coalesced one-sided reads over the
     transport), waits at a barrier so all fetches complete against the OLD
     data, then atomically overwrites its shard. Collective: all ranks must
-    call with the same seed.
+    call with the same seed. Index memory is O(shard) even at 1e9 rows
+    (blocked Feistel permutation above ``_DENSE_MAX``).
     """
+    _reject_ragged(store, name)
     info = store.query(name)
     total = info["total_rows"]
     begin, end = store.my_row_range(name)
-    g = rng or np.random.default_rng(seed)
-    perm = g.permutation(total)
-    mine = perm[begin:end]
+    mine = _shard_perm(total, begin, end, seed, rng)
     fresh = store.get_batch(name, mine)     # reads see old data
     store.barrier()                          # everyone done reading
     store.update(name, fresh, 0)             # then everyone swaps
     store.barrier()
+
+
+def ragged_global_shuffle(store, name: str, seed: int) -> None:
+    """Global shuffle of a ragged variable: sample i's (index row +
+    values span) move TOGETHER to wherever the permutation sends it, and
+    the pair is re-registered so the locality invariant (each sample's
+    elements inside its owner's values shard) holds by construction.
+    This is the SC'23 atomistic-workload shuffle (SURVEY §2.2) the
+    fixed-width path cannot express. Collective; same seed everywhere.
+    """
+    if not store.is_ragged(name):
+        raise ValueError(f"{name!r} is not a ragged variable")
+    total = store.ragged_total(name)
+    begin, end = store.my_row_range(f"{name}/index")
+    src = _shard_perm(total, begin, end, seed, rng=None)
+    values, lengths = store.get_ragged_batch(name, src)  # old data
+    store.barrier()                                      # all reads done
+    samples = (np.split(values, np.cumsum(lengths)[:-1])
+               if len(lengths) else [])
+    store.free(f"{name}/values")
+    store.free(f"{name}/index")
+    store.add_ragged(name, samples)

@@ -19,7 +19,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["shard_pytree", "megatron_rules", "expert_rules",
-           "shardings_of"]
+           "pp_stage_rules", "shardings_of"]
 
 
 def shard_pytree(tree, mesh: Mesh, rules: Callable):
@@ -65,6 +65,49 @@ def megatron_rules(axis: str = "tp") -> Callable:
         if leaf.ndim == 1 and "up" in names and path[-1] == "bias":
             return P(axis)
         return P()
+
+    return rules
+
+
+def pp_stage_rules(pp_axis: str = "pp",
+                   tp_axis: Optional[str] = None) -> Callable:
+    """Sharding rules for a STAGE-STACKED parameter pytree (leading dim =
+    stage, sharded over ``pp_axis``) with optional megatron TP on the
+    remaining dims — the pp×tp composition. ``megatron_rules`` cannot be
+    reused directly here: its row-shard case puts the axis on dim 0,
+    which in a stacked stack is the STAGE dim, not the row dim.
+
+    ==================  =================================
+    every leaf           dim 0 = P(pp)
+    qkv/up kernel        P(pp, None, tp)   (column)
+    proj/down kernel     P(pp, tp, None)   (row)
+    up bias              P(pp, tp)
+    moe w1 / w2          P(pp, None, None, tp) / P(pp, None, tp, None)
+    moe b1               P(pp, None, tp)
+    everything else      P(pp, None, ...)
+    ==================  =================================
+    """
+
+    def rules(path, leaf):
+        nd = leaf.ndim
+        spec = [pp_axis] + [None] * (nd - 1)
+        if tp_axis:
+            names = set(path)
+            if "moe" in names:
+                if path[-1] == "w1" and nd == 4:
+                    spec[3] = tp_axis
+                elif path[-1] == "w2" and nd == 4:
+                    spec[2] = tp_axis
+                elif path[-1] == "b1" and nd == 3:
+                    spec[2] = tp_axis
+            elif path[-1] == "kernel" and nd >= 3:
+                if {"qkv", "up"} & names:
+                    spec[-1] = tp_axis
+                elif {"proj", "down"} & names:
+                    spec[1] = tp_axis
+            elif path[-1] == "bias" and nd == 2 and "up" in names:
+                spec[1] = tp_axis
+        return P(*spec)
 
     return rules
 

@@ -103,15 +103,41 @@ def save_shard(store, name: str, directory: str,
     return stem + ".bin"
 
 
+def _stem(directory: str, name: str, rank: int) -> str:
+    return os.path.join(directory, f"{name.replace('/', '_')}.r{rank}")
+
+
 def load_shard(store, name: str, directory: str, *,
                mmap: bool = False, rank: Optional[int] = None) -> None:
     """Collective: re-register ``name`` from files written by
     :func:`save_shard`. ``mmap=True`` restores in tiered (file-backed,
     read-only) mode; otherwise the shard is copied back into RAM.
-    ``rank`` overrides which rank's file this process loads (for
-    re-sharding onto a differently-ranked relaunch)."""
+    ``rank`` overrides which rank's file this process loads.
+
+    **Elastic resume**: when the checkpoint was written by a DIFFERENT
+    world size (the sidecars record it), each rank re-partitions the
+    saved global row space with the same ``nsplit`` rule the dataset
+    layer uses and reads exactly its byte ranges out of whichever saved
+    files cover them — train on 4 ranks, crash, resume on 2 (or 8) and
+    every global row is served unchanged. This closes SURVEY §5's
+    "elastic recovery: none". (Explicit ``rank=`` keeps the old manual
+    override and skips the re-split.)
+    """
     r = store.rank if rank is None else rank
-    stem = os.path.join(directory, f"{name.replace('/', '_')}.r{r}")
+    stem = _stem(directory, name, r)
+    if rank is None:
+        # Every sidecar records the world it was saved under. Read this
+        # rank's OWN sidecar first — on node-local (non-shared) dirs it
+        # is the only one present — and fall back to r0's (which a
+        # shrunk shared-dir resume always has) when it's missing.
+        probe = stem if os.path.exists(stem + ".json") \
+            else _stem(directory, name, 0)
+        with open(probe + ".json") as f:
+            saved_world = json.load(f)["world"]
+        if saved_world != store.world:
+            _load_shard_resharded(store, name, directory, saved_world,
+                                  mmap=mmap)
+            return
     with open(stem + ".json") as f:
         meta = json.load(f)
     dtype = np.dtype(meta["dtype"])
@@ -123,4 +149,49 @@ def load_shard(store, name: str, directory: str, *,
         arr = (np.fromfile(stem + ".bin", dtype=dtype)
                .reshape((nrows,) + sample_shape)) if nrows else \
             np.empty((0,) + sample_shape, dtype)
+        store.add(name, arr)
+
+
+def _load_shard_resharded(store, name: str, directory: str,
+                          saved_world: int, *, mmap: bool) -> None:
+    """Re-split a saved checkpoint across the CURRENT world size: this
+    rank's target row range (same near-equal contiguous split the
+    dataset adapter uses) is assembled from the saved files that overlap
+    it — np.memmap reads touch only the needed pages, so a resume moves
+    each byte once."""
+    from ..data.dataset import nsplit
+
+    metas = []
+    for i in range(saved_world):
+        with open(_stem(directory, name, i) + ".json") as f:
+            metas.append(json.load(f))
+    dtype = np.dtype(metas[0]["dtype"])
+    sample_shape = tuple(metas[0]["sample_shape"])
+    total = sum(m["nrows"] for m in metas)
+    counts = nsplit(total, store.world)
+    begin = int(sum(counts[: store.rank]))
+    end = begin + counts[store.rank]
+
+    arr = np.empty((end - begin,) + sample_shape, dtype)
+    file_start = 0
+    for i, m in enumerate(metas):
+        fs, fe = file_start, file_start + m["nrows"]
+        file_start = fe
+        lo, hi = max(begin, fs), min(end, fe)
+        if lo >= hi:
+            continue
+        src = np.memmap(_stem(directory, name, i) + ".bin", dtype=dtype,
+                        mode="r", shape=(m["nrows"],) + sample_shape)
+        arr[lo - begin:hi - begin] = src[lo - fs:hi - fs]
+        del src
+    if mmap:
+        # Tiered restore across a world change: the re-split rows must
+        # live in ONE backing file per rank; write it next to the saved
+        # ones (suffixed by the new world so reruns don't collide) and
+        # map that.
+        stem = _stem(directory, name, store.rank) + f".w{store.world}"
+        arr.tofile(stem + ".bin")
+        del arr
+        store.add_mmap(name, stem + ".bin", dtype, sample_shape)
+    else:
         store.add(name, arr)

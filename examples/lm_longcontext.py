@@ -51,8 +51,16 @@ def main():
                         "update (the big-batch update in 1/N the "
                         "activation memory)")
     p.add_argument("--generate", type=int, default=0, metavar="N",
-                   help="after training, greedily decode N tokens from "
-                        "the first training window's prefix (KV-cached)")
+                   help="after training, decode N tokens from the first "
+                        "training window's prefix (KV-cached; greedy "
+                        "unless --temperature)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature for --generate (0=greedy)")
+    p.add_argument("--top-k", type=int, default=None,
+                   help="restrict sampling to the k most likely tokens")
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: smallest token set with "
+                        "cumulative probability >= p")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--steps", type=int, default=None)
     args = p.parse_args()
@@ -142,7 +150,10 @@ def main():
         infer = model.clone(mesh=None)  # decode is single-host
         plen = min(32, args.seq)
         prompt = jnp.asarray(windows[:1, :plen])
-        out = decode.generate(infer, state.params, prompt, args.generate)
+        out = decode.generate(infer, state.params, prompt, args.generate,
+                              temperature=args.temperature,
+                              key=jax.random.key(args.seed + 1),
+                              top_k=args.top_k, top_p=args.top_p)
         cont = np.asarray(out[0, plen:])
         want = corpus[int(starts[0]) + plen:
                       int(starts[0]) + plen + args.generate]

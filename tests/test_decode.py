@@ -178,3 +178,107 @@ def test_generate_moe_smoke():
     assert out.shape == (1, 7)
     assert ((np.asarray(out) >= 0) & (np.asarray(out)
                                       < model.vocab)).all()
+
+
+# ---------------------------------------------------------------------------
+# Decode v2: top-k/top-p, padded variable-length batches (VERDICT r3 #8)
+# ---------------------------------------------------------------------------
+
+
+def test_filter_logits_top_k():
+    lg = jnp.array([[1.0, 5.0, 3.0, 2.0], [4.0, 0.0, -1.0, 4.5]])
+    out = np.asarray(decode.filter_logits(lg, top_k=2))
+    assert np.isfinite(out[0, [1, 2]]).all() and np.isinf(out[0, [0, 3]]).all()
+    assert np.isfinite(out[1, [0, 3]]).all() and np.isinf(out[1, [1, 2]]).all()
+
+
+def test_filter_logits_top_p():
+    # softmax([big, mid, tiny]): top_p just over the max keeps only it;
+    # top_p=1.0 keeps everything.
+    lg = jnp.array([[10.0, 9.0, -10.0]])
+    out = np.asarray(decode.filter_logits(lg, top_p=0.5))
+    assert np.isfinite(out[0, 0]) and np.isinf(out[0, 1:]).all()
+    out_all = np.asarray(decode.filter_logits(lg, top_p=1.0))
+    assert np.isfinite(out_all).all()
+    # the argmax always survives even with tiny p
+    out_tiny = np.asarray(decode.filter_logits(lg, top_p=1e-9))
+    assert np.isfinite(out_tiny[0, 0])
+
+
+def test_filter_logits_validates():
+    lg = jnp.zeros((1, 4))
+    with pytest.raises(ValueError):
+        decode.filter_logits(lg, top_k=0)
+    with pytest.raises(ValueError):
+        decode.filter_logits(lg, top_p=0.0)
+
+
+def test_generate_top_k_sampling_respects_mask():
+    """With top_k=1, sampling at any temperature IS greedy."""
+    model = _model()
+    params = _params(model)
+    prompt = jax.random.randint(jax.random.key(3), (2, 4), 0, model.vocab)
+    greedy = decode.generate(model, params, prompt, 6)
+    k1 = decode.generate(model, params, prompt, 6, temperature=5.0,
+                         key=jax.random.key(7), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_generate_padded_batch_matches_per_row():
+    """THE padded-batch oracle: greedy generation of a padded
+    variable-length batch equals generating each row alone at its exact
+    length — masking bugs, position bugs, or cache-slot bugs all break
+    this."""
+    model = _model()
+    params = _params(model)
+    # trained-ish LN (see test_generate_greedy_matches_naive)
+    lnf = params["params"]["lmhead"]["lnf"]
+    lnf["scale"] = lnf["scale"] + jax.random.uniform(
+        jax.random.key(9), lnf["scale"].shape, minval=0.5, maxval=1.5)
+    new = 6
+    rows = [jax.random.randint(jax.random.key(10 + i), (1, ln), 0,
+                               model.vocab)
+            for i, ln in enumerate([3, 5, 2])]
+    plen = 5
+    lengths = jnp.array([3, 5, 2], jnp.int32)
+    padded = jnp.concatenate([
+        jnp.pad(r, ((0, 0), (0, plen - r.shape[1])),
+                constant_values=63)  # pad value deliberately a real token
+        for r in rows], axis=0)
+    got = decode.generate(model, params, padded, new,
+                          prompt_lengths=lengths)
+    assert got.shape == (3, plen + new)
+    for i, r in enumerate(rows):
+        alone = decode.generate(model, params, r, new)
+        # row i's generated tokens live in columns [plen, plen+new)
+        np.testing.assert_array_equal(
+            np.asarray(got[i, plen:]),
+            np.asarray(alone[0, r.shape[1]:]),
+            err_msg=f"row {i} (len {r.shape[1]})")
+
+
+def test_generate_padded_full_length_rows_match_uniform():
+    """lengths == plen everywhere: the padded path must reduce exactly
+    to the uniform one."""
+    model = _model()
+    params = _params(model)
+    prompt = jax.random.randint(jax.random.key(5), (2, 4), 0, model.vocab)
+    uni = decode.generate(model, params, prompt, 5)
+    pad = decode.generate(model, params, prompt, 5,
+                          prompt_lengths=jnp.array([4, 4], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(uni), np.asarray(pad))
+
+
+def test_generate_padded_rejects_bad_lengths():
+    model = _model()
+    params = _params(model)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError):  # wrong shape
+        decode.generate(model, params, prompt, 2,
+                        prompt_lengths=jnp.array([4], jnp.int32))
+    with pytest.raises(ValueError):  # zero length
+        decode.generate(model, params, prompt, 2,
+                        prompt_lengths=jnp.array([0, 4], jnp.int32))
+    with pytest.raises(ValueError):  # beyond the padded width
+        decode.generate(model, params, prompt, 2,
+                        prompt_lengths=jnp.array([4, 5], jnp.int32))
