@@ -124,10 +124,7 @@ def create_train_state(rng: jax.Array, batch: GraphBatch, lr: float = 1e-3,
         # ZeRO-3 for the GNN family (VERDICT r3 weak #6): shard each
         # leaf's largest divisible dim; small leaves stay replicated.
         from ..parallel.fsdp import place_zero3
-        params, opt_state = place_zero3(params, tx, mesh)
-        step0 = jax.device_put(jnp.zeros((), jnp.int32),
-                               NamedSharding(mesh, P()))
-        return model, TrainState(params, opt_state, step0), tx
+        return model, TrainState(*place_zero3(params, tx, mesh)), tx
     state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
     if mesh is not None:
         state = jax.device_put(state, NamedSharding(mesh, P()))

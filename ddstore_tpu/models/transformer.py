@@ -293,41 +293,34 @@ def create_train_state(rng: jax.Array, model: TransformerLM,
     if mesh is None:
         return TrainState(params, tx.init(params),
                           jnp.zeros((), jnp.int32)), tx
-    repl = NamedSharding(mesh, P())
+    from ..parallel.fsdp import fsdp_compose, fsdp_rules, place_zero3
     tp = mesh.shape.get("tp", 1) > 1
     ep = mesh.shape.get("ep", 1) > 1
-    if mesh.shape.get("fsdp", 1) > 1 and (tp or ep):
+    fsdp = mesh.shape.get("fsdp", 1) > 1
+    if fsdp and (tp or ep):
         # fsdp×tp / fsdp×ep: megatron/expert placement first, then ZeRO
         # shards each leaf's largest still-unsharded dim over fsdp (the
         # round-3 hard refusal here is gone — VERDICT r3 missing #1).
-        from ..parallel.fsdp import fsdp_compose
         base = expert_rules("ep", "tp" if tp else None) if ep \
             else megatron_rules("tp")
-        params = shard_pytree(params, mesh, fsdp_compose(base, mesh))
+        rules = fsdp_compose(base, mesh)
     elif ep:
         # Experts over ep (optionally composed with megatron TP).
-        params = shard_pytree(params, mesh,
-                              expert_rules("ep", "tp" if tp else None))
+        rules = expert_rules("ep", "tp" if tp else None)
     elif tp:
         # Megatron-style TP: place params per the sharding rules; the
         # optimizer state inherits placement via zeros_like.
-        params = shard_pytree(params, mesh, megatron_rules("tp"))
-    elif mesh.shape.get("fsdp", 1) > 1:
+        rules = megatron_rules("tp")
+    elif fsdp:
         # ZeRO-3: params (and optimizer moments via zeros_like) sharded
         # across the fsdp axis; XLA all-gathers for compute and
         # reduce-scatters the gradients.
-        from ..parallel.fsdp import fsdp_rules
-        params = shard_pytree(params, mesh, fsdp_rules(mesh))
+        rules = fsdp_rules(mesh)
     else:
-        params = jax.device_put(params, repl)
-    state = TrainState(params, tx.init(params),
-                       jnp.zeros((), jnp.int32))
-    # Stragglers (optimizer scalars like adam's count) still live on a
-    # single device; one jit must not mix meshes, so replicate them.
-    fix = lambda x: x if isinstance(getattr(x, "sharding", None),
-                                    NamedSharding) else \
-        jax.device_put(x, repl)
-    return jax.tree_util.tree_map(fix, state), tx
+        rules = lambda path, leaf: P()  # replicated (pure dp/sp)
+    # Shared placement tail (see place_zero3): shard/replicate params,
+    # init the optimizer on the placed params, replicate stragglers.
+    return TrainState(*place_zero3(params, tx, mesh, rules)), tx
 
 
 def make_train_step(model: TransformerLM, tx: optax.GradientTransformation,

@@ -102,10 +102,7 @@ def create_train_state(rng: jax.Array, lr: float = 1e-3,
             # ZeRO-3 placement for the VAE family too (VERDICT r3 weak
             # #6: fsdp was transformer-only).
             from ..parallel.fsdp import place_zero3
-            params, opt_state = place_zero3(params, tx, mesh)
-            step0 = jax.device_put(jnp.zeros((), jnp.int32),
-                                   NamedSharding(mesh, P()))
-            state = TrainState(params, opt_state, step0)
+            state = TrainState(*place_zero3(params, tx, mesh))
         else:
             # Parameters replicated across the mesh (pure DP); batch
             # sharded.

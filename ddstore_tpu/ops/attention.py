@@ -367,7 +367,8 @@ def _fit_block(block: int, s: int) -> int:
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, q_offset: int = 0,
                     kv_offset: int = 0, scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 2048,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Pallas flash attention over (B, H, S, D); returns (out, lse).
@@ -381,15 +382,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     block_q/block_k are upper bounds, fitted per call to the largest
     divisor of the sequence length that is a multiple of 8. The defaults
-    are tuned for TPU (v5e measured: 512x2048 hits ~101 TF/s useful vs
-    ~13 TF/s at 128x128 — grid-step overhead, not FLOPs, dominates small
-    blocks).
+    are length-adaptive, tuned on v5e: 512x2048 below S=8192 (measured
+    ~101 TF/s useful vs ~13 TF/s at 128x128 — grid-step overhead, not
+    FLOPs, dominates small blocks) and 1024x1024 at S>=8192 (measured
+    6% faster fwd+bwd there; 2048-wide q blocks exceed VMEM).
     """
     if not _HAS_PALLAS:  # pragma: no cover
         return mha_reference(q, k, v, causal=causal, q_offset=q_offset,
                              kv_offset=kv_offset, scale=scale)
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if block_q is None:
+        block_q = 1024 if sq >= 8192 else 512
+    if block_k is None:
+        block_k = 1024 if sq >= 8192 else 2048
     # Block sizes are upper bounds: fit each to the largest multiple of 8
     # (Mosaic sublane tile) that divides the sequence. Any seq length
     # divisible by 8 therefore works with the big TPU-tuned defaults

@@ -74,7 +74,11 @@ def place_zero3(params, tx, mesh: Mesh, rules: Optional[Callable] = None):
     params by ``rules`` (default :func:`fsdp_rules`), init the optimizer
     on the placed params (moments inherit via zeros_like), and replicate
     any straggler leaves (optimizer scalars like adam's count) so one
-    jit never mixes meshes. Returns ``(params, opt_state)``."""
+    jit never mixes meshes. Returns ``(params, opt_state, step0)`` —
+    the positional fields of every family's TrainState, so callers
+    assemble theirs as ``TrainState(*place_zero3(...))``."""
+    import jax.numpy as jnp
+
     from .tp import shard_pytree
 
     params = shard_pytree(params, mesh, rules or fsdp_rules(mesh))
@@ -83,7 +87,8 @@ def place_zero3(params, tx, mesh: Mesh, rules: Optional[Callable] = None):
     fix = lambda x: x if isinstance(getattr(x, "sharding", None),
                                     NamedSharding) else \
         jax.device_put(x, repl)
-    return params, jax.tree_util.tree_map(fix, opt_state)
+    return (params, jax.tree_util.tree_map(fix, opt_state),
+            jax.device_put(jnp.zeros((), jnp.int32), repl))
 
 
 def data_axes(mesh: Mesh, axis: str = "dp") -> Optional[Tuple[str, ...]]:

@@ -38,6 +38,16 @@ def main():
     p.add_argument("--dim", type=int, default=128)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline stages (>1 selects the pipelined train "
+                        "step; composes with dp, sp and --tp)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="megatron tensor-parallel axis size (composes "
+                        "with --pp: stage stacks carry the TP sharding)")
+    p.add_argument("--microbatches", type=int, default=2,
+                   help="microbatches per step under --pp")
+    p.add_argument("--schedule", choices=("gpipe", "1f1b"),
+                   default="gpipe", help="pipeline schedule under --pp")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--remat-policy", type=str, default=None,
@@ -81,8 +91,22 @@ def main():
 
     n_dev = len(jax.local_devices())
     dp = min(args.dp, n_dev)
-    sp = n_dev // dp
-    mesh = make_mesh({"dp": dp, "sp": sp}, jax.local_devices()[:dp * sp])
+    pp, tp = args.pp, args.tp
+    if n_dev < dp * pp * tp:
+        raise SystemExit(f"dp*pp*tp={dp * pp * tp} needs more than the "
+                         f"{n_dev} local devices")
+    # Largest usable subset (a 6-device host with --dp 4 still trains on
+    # 4 devices, matching the pre-pp behavior); leftover capacity after
+    # dp*pp*tp becomes the sequence axis.
+    sp = n_dev // (dp * pp * tp)
+    axes = {"dp": dp}
+    if pp > 1:
+        axes["pp"] = pp
+    if tp > 1:
+        axes["tp"] = tp
+    if sp > 1:
+        axes["sp"] = sp
+    mesh = make_mesh(axes, jax.local_devices()[:dp * pp * tp * sp])
 
     group = auto_group()
     store = DDStore(group)
@@ -98,19 +122,39 @@ def main():
                      ).astype(np.int32)
     ds = ShardedDataset(store, windows, nexts)
 
+    # XLA's CPU backend crashes promoting bf16 all-reduces that carry a
+    # copy (hit by pp/tp compositions); TPU has native bf16 collectives.
+    # Smoke runs on virtual CPU devices therefore compute in f32.
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
+        else jnp.float32
     model = transformer.TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.dim // 32,
-        layers=args.layers,
+        layers=args.layers, compute_dtype=dtype,
         mesh=mesh, remat=args.remat or args.remat_policy is not None,
         remat_policy=args.remat_policy)
-    state, tx = transformer.create_train_state(
-        jax.random.key(args.seed), model, lr=args.lr, mesh=mesh)
-    step = transformer.make_train_step(model, tx, mesh=mesh, state=state,
-                                       accum_steps=args.accum_steps)
+    if pp > 1:
+        # Pipelined step: stages over pp (megatron-sharded over tp when
+        # set, ring attention over sp inside each stage).
+        if args.accum_steps != 1:
+            raise SystemExit("--accum-steps composes with the sequential "
+                             "step only; under --pp use --microbatches")
+        state, tx = transformer.create_pp_train_state(
+            jax.random.key(args.seed), model, n_stages=pp, lr=args.lr,
+            mesh=mesh)
+        step = transformer.make_pp_train_step(
+            model, tx, mesh, n_stages=pp,
+            n_microbatches=args.microbatches, schedule=args.schedule)
+        batch = args.microbatches * 2 * dp
+    else:
+        state, tx = transformer.create_train_state(
+            jax.random.key(args.seed), model, lr=args.lr, mesh=mesh)
+        step = transformer.make_train_step(model, tx, mesh=mesh,
+                                           state=state,
+                                           accum_steps=args.accum_steps)
+        batch = 2 * dp
 
     sampler = DistributedSampler(len(ds), store.world_group.size,
                                  store.world_group.rank, seed=args.seed)
-    batch = 2 * dp
     pos = jnp.tile(jnp.arange(args.seq, dtype=jnp.int32), (batch, 1))
     import contextlib
 
@@ -118,7 +162,7 @@ def main():
     for epoch in range(args.epochs):
         sampler.set_epoch(epoch)
         loader = DeviceLoader(ds, sampler, batch_size=batch, mesh=mesh,
-                              spec=jax.P("dp", "sp"))
+                              spec=jax.P("dp", "sp" if sp > 1 else None))
         tracing = trace(args.profile) if (args.profile and epoch == 0) \
             else contextlib.nullcontext()
         t0 = time.perf_counter()
@@ -148,9 +192,15 @@ def main():
         # echo the pattern.
         from ddstore_tpu.models import decode
         infer = model.clone(mesh=None)  # decode is single-host
+        params = state.params
+        if pp > 1:  # reassemble the stage stacks into flat params
+            outer, stages = params
+            params = transformer.lm_from_stages(
+                jax.device_get(outer), jax.device_get(stages),
+                model.layers, pp)
         plen = min(32, args.seq)
         prompt = jnp.asarray(windows[:1, :plen])
-        out = decode.generate(infer, state.params, prompt, args.generate,
+        out = decode.generate(infer, params, prompt, args.generate,
                               temperature=args.temperature,
                               key=jax.random.key(args.seed + 1),
                               top_k=args.top_k, top_p=args.top_p)

@@ -282,3 +282,30 @@ def test_generate_padded_rejects_bad_lengths():
     with pytest.raises(ValueError):  # beyond the padded width
         decode.generate(model, params, prompt, 2,
                         prompt_lengths=jnp.array([4, 5], jnp.int32))
+
+
+def test_generate_sp_prefill_matches_meshfree():
+    """prefill_mesh runs the one-pass prompt prefill under ring
+    attention (sequence sharded over sp); tokens must equal the
+    mesh-free greedy path exactly."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from ddstore_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 1, "sp": 2})
+    model = _model()
+    params = _params(model)
+    lnf = params["params"]["lmhead"]["lnf"]
+    lnf["scale"] = lnf["scale"] + jax.random.uniform(
+        jax.random.key(9), lnf["scale"].shape, minval=0.5, maxval=1.5)
+    prompt = jax.random.randint(jax.random.key(3), (2, 16), 0, model.vocab)
+    base = decode.generate(model, params, prompt, 5)
+    spm = model.clone(mesh=mesh)
+
+    @jax.jit
+    def gen(params, prompt):
+        return decode.generate(spm, params, prompt, 5,
+                               prefill_mesh=mesh)
+
+    got = gen(params, prompt)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(got))
