@@ -549,14 +549,15 @@ def _make_stage_fn(model: "TransformerLM", n_stages: int,
 def create_pp_train_state(rng: jax.Array, model: TransformerLM,
                           n_stages: int, lr: float = 3e-4,
                           mesh: Optional[Mesh] = None, pp_axis: str = "pp",
-                          tp_axis: str = "tp"
+                          tp_axis: str = "tp", ep_axis: str = "ep"
                           ) -> Tuple[TrainState, optax.GradientTransformation]:
     """TrainState whose params are ``(outer, stages)`` with the stage
     stack sharded over ``pp`` (optimizer state inherits the placement).
     On a mesh with a >1 ``tp_axis`` the stacks also carry megatron TP on
     their non-stage dims (pp×tp) and the outer LM head shards its vocab
-    dim over tp; the schedules are manual over pp/dp only, so GSPMD
-    inserts the megatron all-reduces inside each stage."""
+    dim over tp; a >1 ``ep_axis`` shards MoE stacks' expert dim (pp×ep).
+    The schedules are manual over pp/dp only, so GSPMD inserts the
+    megatron/expert collectives inside each stage."""
     tok = jnp.zeros((1, 8), jnp.int32)
     params = model.clone(mesh=None).init(rng, tok,
                                          jnp.tile(jnp.arange(8), (1, 1)))
@@ -565,9 +566,11 @@ def create_pp_train_state(rng: jax.Array, model: TransformerLM,
         from ..parallel.tp import pp_stage_rules
         repl = NamedSharding(mesh, P())
         tp = tp_axis if mesh.shape.get(tp_axis, 1) > 1 else None
+        ep = ep_axis if mesh.shape.get(ep_axis, 1) > 1 else None
         outer = shard_pytree(outer, mesh, megatron_rules(tp)) if tp \
             else jax.device_put(outer, repl)
-        stages = shard_pytree(stages, mesh, pp_stage_rules(pp_axis, tp))
+        stages = shard_pytree(stages, mesh,
+                              pp_stage_rules(pp_axis, tp, ep))
     tx = optax.adam(lr)
     pp_params = (outer, stages)
     state = TrainState(pp_params, tx.init(pp_params),

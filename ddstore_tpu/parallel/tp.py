@@ -70,20 +70,22 @@ def megatron_rules(axis: str = "tp") -> Callable:
 
 
 def pp_stage_rules(pp_axis: str = "pp",
-                   tp_axis: Optional[str] = None) -> Callable:
+                   tp_axis: Optional[str] = None,
+                   ep_axis: Optional[str] = None) -> Callable:
     """Sharding rules for a STAGE-STACKED parameter pytree (leading dim =
-    stage, sharded over ``pp_axis``) with optional megatron TP on the
-    remaining dims — the pp×tp composition. ``megatron_rules`` cannot be
-    reused directly here: its row-shard case puts the axis on dim 0,
-    which in a stacked stack is the STAGE dim, not the row dim.
+    stage, sharded over ``pp_axis``) with optional megatron TP — and,
+    for MoE stacks, expert parallelism — on the remaining dims (the
+    pp×tp and pp×ep compositions). ``megatron_rules``/``expert_rules``
+    cannot be reused directly here: their leading-dim cases land on the
+    STAGE dim in a stacked stack.
 
     ==================  =================================
     every leaf           dim 0 = P(pp)
     qkv/up kernel        P(pp, None, tp)   (column)
     proj/down kernel     P(pp, tp, None)   (row)
     up bias              P(pp, tp)
-    moe w1 / w2          P(pp, None, None, tp) / P(pp, None, tp, None)
-    moe b1               P(pp, None, tp)
+    moe w1 / w2          P(pp, ep, None, tp) / P(pp, ep, tp, None)
+    moe b1 / b2          P(pp, ep, tp) / P(pp, ep, None)
     everything else      P(pp, None, ...)
     ==================  =================================
     """
@@ -91,16 +93,19 @@ def pp_stage_rules(pp_axis: str = "pp",
     def rules(path, leaf):
         nd = leaf.ndim
         spec = [pp_axis] + [None] * (nd - 1)
-        if tp_axis:
-            names = set(path)
-            if "moe" in names:
+        names = set(path)
+        if "moe" in names:
+            if path[-1] in ("w1", "w2", "b1", "b2") and nd >= 3:
+                spec[1] = ep_axis  # expert dim (None when ep unset)
+            if tp_axis:
                 if path[-1] == "w1" and nd == 4:
                     spec[3] = tp_axis
                 elif path[-1] == "w2" and nd == 4:
                     spec[2] = tp_axis
                 elif path[-1] == "b1" and nd == 3:
                     spec[2] = tp_axis
-            elif path[-1] == "kernel" and nd >= 3:
+        elif tp_axis:
+            if path[-1] == "kernel" and nd >= 3:
                 if {"qkv", "up"} & names:
                     spec[-1] = tp_axis
                 elif {"proj", "down"} & names:

@@ -331,3 +331,37 @@ def test_stage_split_refuses_empty_stage():
         transformer.lm_to_stages(params, 4, 3)  # stages [2,2,0]
     with pytest.raises(ValueError, match="zero real layers"):
         transformer.lm_to_stages(params, 2, 8)
+
+
+# ---------------------------------------------------------------------------
+# pp × ep (expert-sharded MoE stacks inside the pipeline)
+# ---------------------------------------------------------------------------
+
+
+def test_pp_ep_losses_match_and_sharded():
+    mesh = make_mesh({"pp": 2, "ep": 2})
+    model = _model(n_experts=2)
+    tokens, targets, positions = _batch(b=4, s=8)
+
+    state, tx = transformer.create_train_state(jax.random.key(0), model,
+                                               lr=1e-2)
+    step = transformer.make_train_step(model, tx, donate=False)
+    want = []
+    st = state
+    for _ in range(2):
+        st, loss = step(st, tokens, targets, positions)
+        want.append(float(loss))
+
+    pstate, ptx = transformer.create_pp_train_state(
+        jax.random.key(0), model, n_stages=2, lr=1e-2, mesh=mesh)
+    _, stages = pstate.params
+    w1 = stages["layer0"]["moe"]["w1"]
+    assert w1.sharding.spec == jax.sharding.PartitionSpec(
+        "pp", "ep", None, None), w1.sharding.spec
+    pstep = transformer.make_pp_train_step(model, ptx, mesh, n_stages=2,
+                                           n_microbatches=4, donate=False)
+    got = []
+    for _ in range(2):
+        pstate, loss = pstep(pstate, tokens, targets, positions)
+        got.append(float(loss))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
