@@ -25,7 +25,9 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.attention import flash_attention, mha_reference
-from ..parallel.pipeline import (pipeline_1f1b, pipeline_apply,
+from ..parallel.pipeline import (interleave_order, pipeline_1f1b,
+                                 pipeline_apply,
+                                 pipeline_interleaved,
                                  stack_stage_params)
 from ..parallel.ring_attention import ring_attention
 from ..parallel.tp import (expert_rules, megatron_rules, shard_pytree,
@@ -426,12 +428,16 @@ def _stage_group_size(layers: int, n_stages: int) -> int:
     return g
 
 
-def lm_to_stages(params, layers: int, n_stages: int):
+def lm_to_stages(params, layers: int, n_stages: int, n_virtual: int = 1):
     """Split TransformerLM params into (outer, stage-stacked blocks).
 
-    outer keeps embed/lmhead; the blocks are grouped into ``n_stages``
-    contiguous groups of ``ceil(layers / n_stages)`` and stacked along a
-    new leading stage dim (see ``stack_stage_params``).
+    outer keeps embed/lmhead; the blocks are grouped into
+    ``n_stages * n_virtual`` contiguous groups of
+    ``ceil(layers / (n_stages*n_virtual))`` and stacked along a new
+    leading dim (see ``stack_stage_params``). With ``n_virtual > 1``
+    (the interleaved schedule) the stack is DEVICE-MAJOR: position
+    ``d*V + v`` holds model chunk ``v*S + d``, matching
+    :func:`ddstore_tpu.parallel.pipeline.pipeline_interleaved`.
 
     **Uneven depths** (``layers % n_stages != 0`` — VERDICT r3 weak #8's
     hard refusal): trailing stages are padded with ZERO-parameter layers
@@ -442,15 +448,16 @@ def lm_to_stages(params, layers: int, n_stages: int):
     block compute, (g*n_stages - layers)/layers of the block FLOPs
     (~3% at layers=31, pp=8) — far cheaper than refusing the config.
     """
-    g = _stage_group_size(layers, n_stages)
+    n_chunks = n_stages * n_virtual
+    g = _stage_group_size(layers, n_chunks)
     p = params["params"]
     outer = {k: v for k, v in p.items() if not k.startswith("block")}
     # Zero template only when a pad slot exists (the common even split
     # shouldn't allocate a block-sized buffer for nothing).
     zeros = jax.tree_util.tree_map(jnp.zeros_like, p["block0"]) \
-        if g * n_stages > layers else None
+        if g * n_chunks > layers else None
     per_stage = []
-    for st in range(n_stages):
+    for st in range(n_chunks):
         stage = {}
         valid = []
         for j in range(g):
@@ -463,20 +470,25 @@ def lm_to_stages(params, layers: int, n_stages: int):
         # and adam never moves it.
         stage["_valid"] = jnp.asarray(valid, jnp.float32)
         per_stage.append(stage)
-    return {"params": outer}, stack_stage_params(per_stage)
+    order = interleave_order(n_stages, n_virtual)
+    return {"params": outer}, stack_stage_params(
+        [per_stage[k] for k in order])
 
 
-def lm_from_stages(outer, stages, layers: int, n_stages: int):
+def lm_from_stages(outer, stages, layers: int, n_stages: int,
+                   n_virtual: int = 1):
     """Inverse of ``lm_to_stages`` (for checkpoints / oracle tests);
     padded layers are dropped."""
-    g = _stage_group_size(layers, n_stages)
+    n_chunks = n_stages * n_virtual
+    g = _stage_group_size(layers, n_chunks)
+    order = interleave_order(n_stages, n_virtual)
     p = dict(outer["params"])
-    for st in range(n_stages):
+    for pos, st in enumerate(order):
         for j in range(g):
             li = st * g + j
             if li < layers:
                 p[f"block{li}"] = jax.tree_util.tree_map(
-                    lambda l: l[st], stages[f"layer{j}"])
+                    lambda l: l[pos], stages[f"layer{j}"])
     return {"params": p}
 
 
@@ -549,7 +561,8 @@ def _make_stage_fn(model: "TransformerLM", n_stages: int,
 def create_pp_train_state(rng: jax.Array, model: TransformerLM,
                           n_stages: int, lr: float = 3e-4,
                           mesh: Optional[Mesh] = None, pp_axis: str = "pp",
-                          tp_axis: str = "tp", ep_axis: str = "ep"
+                          tp_axis: str = "tp", ep_axis: str = "ep",
+                          n_virtual: int = 1
                           ) -> Tuple[TrainState, optax.GradientTransformation]:
     """TrainState whose params are ``(outer, stages)`` with the stage
     stack sharded over ``pp`` (optimizer state inherits the placement).
@@ -557,11 +570,14 @@ def create_pp_train_state(rng: jax.Array, model: TransformerLM,
     their non-stage dims (pp×tp) and the outer LM head shards its vocab
     dim over tp; a >1 ``ep_axis`` shards MoE stacks' expert dim (pp×ep).
     The schedules are manual over pp/dp only, so GSPMD inserts the
-    megatron/expert collectives inside each stage."""
+    megatron/expert collectives inside each stage. ``n_virtual > 1``
+    builds the V·S device-major chunk stack for
+    ``schedule="interleaved"`` (P(pp) on the leading dim then hands each
+    device exactly its V chunks)."""
     tok = jnp.zeros((1, 8), jnp.int32)
     params = model.clone(mesh=None).init(rng, tok,
                                          jnp.tile(jnp.arange(8), (1, 1)))
-    outer, stages = lm_to_stages(params, model.layers, n_stages)
+    outer, stages = lm_to_stages(params, model.layers, n_stages, n_virtual)
     if mesh is not None:
         from ..parallel.tp import pp_stage_rules
         repl = NamedSharding(mesh, P())
@@ -598,24 +614,31 @@ def pp_gpipe_value_and_grad(model: TransformerLM, stage_fn, pp_params,
                             remat: bool = False, with_aux: bool = False,
                             aux_weight: float = 0.0,
                             fused_xent: bool = False,
-                            xent_block: int = 8192):
+                            xent_block: int = 8192,
+                            n_virtual: int = 1):
     """Loss + full-model gradients via GPipe (pipeline_apply under
     autodiff). THE production gradient path of
-    ``make_pp_train_step(schedule="gpipe")`` — tests call it directly."""
+    ``make_pp_train_step(schedule="gpipe")`` — tests call it directly.
+    With ``n_virtual > 1`` the ring runs the interleaved virtual-stage
+    schedule instead (``schedule="interleaved"``; the stage stack must
+    be device-major, see ``lm_to_stages``) — same autodiff backward,
+    V× smaller bubble."""
 
     def lossf(pp_params):
         outer, stages = pp_params
         x = _embed_apply(model, outer, tokens, positions)
         b = x.shape[0]
         xm = _microbatch(x, n_microbatches)
-        if with_aux:
-            ym, aux = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
-                                     axis=pp_axis, dp_axis=dp_axis,
-                                     remat=remat, with_aux=True)
+        if n_virtual > 1:
+            out = pipeline_interleaved(stage_fn, stages, xm, mesh=mesh,
+                                       n_virtual=n_virtual, axis=pp_axis,
+                                       dp_axis=dp_axis, remat=remat,
+                                       with_aux=with_aux)
         else:
-            ym = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
-                                axis=pp_axis, dp_axis=dp_axis, remat=remat)
-            aux = 0.0
+            out = pipeline_apply(stage_fn, stages, xm, mesh=mesh,
+                                 axis=pp_axis, dp_axis=dp_axis,
+                                 remat=remat, with_aux=with_aux)
+        ym, aux = out if with_aux else (out, 0.0)
         y = ym.reshape(b, *ym.shape[2:])
         return _head_xent(model, outer["params"]["lmhead"], y, targets,
                           fused_xent, xent_block) + aux_weight * aux
@@ -670,7 +693,8 @@ def make_pp_train_step(model: TransformerLM,
                        donate: bool = True, remat: bool = False,
                        schedule: str = "gpipe",
                        fused_xent: Optional[bool] = None,
-                       xent_block: int = 8192):
+                       xent_block: int = 8192,
+                       n_virtual: int = 1):
     """Jitted dp×pp train step over ``(tokens, targets, positions)``.
 
     The batch dim must be ``n_microbatches * mb`` with ``mb`` divisible
@@ -684,6 +708,12 @@ def make_pp_train_step(model: TransformerLM,
       schedule whose stash is bounded by the stage count (O(S) vs O(M));
       the head + loss run inside the last stage's schedule slot and the
       embedding gradient chains through the returned input cotangent.
+    * ``"interleaved"`` — :func:`pipeline_interleaved` with
+      ``n_virtual`` chunks per device (Megatron-style looping): the
+      GPipe bubble ``(S-1)/(M+S-1)`` shrinks to ``(S-1)/(M·V+S-1)``;
+      autodiff backward like gpipe. Requires a train state built with
+      the same ``n_virtual`` (device-major chunk stack) and
+      ``n_microbatches`` divisible by the pp axis size.
 
     MoE models (``n_experts > 0``) work under both schedules: the Switch
     load-balancing aux each block sows is threaded through the pipeline
@@ -695,8 +725,12 @@ def make_pp_train_step(model: TransformerLM,
     whereas the sequential step computes it over the whole batch at
     once; capacity clipping therefore sees microbatch-sized token sets.
     """
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError(f"unknown schedule: {schedule!r}")
+    if schedule != "interleaved" and n_virtual != 1:
+        raise ValueError(
+            f"n_virtual={n_virtual} only applies to "
+            f"schedule='interleaved', got {schedule!r}")
     if fused_xent is None:
         # THE same auto rule as lm_loss (>= 2 blocks or fusing is pure
         # overhead, and never under megatron TP — the head kernel is
@@ -707,7 +741,9 @@ def make_pp_train_step(model: TransformerLM,
             and not mesh.shape.get(tp_axis, 1) > 1
     moe = model.n_experts > 0
     aux_weight = MOE_AUX_WEIGHT if moe else 0.0
-    stage_fn = _make_stage_fn(model, n_stages, with_aux=moe, mesh=mesh)
+    # Interleaved splits the model at chunk (= stage/V) granularity.
+    stage_fn = _make_stage_fn(model, n_stages * n_virtual, with_aux=moe,
+                              mesh=mesh)
     dp = dp_axis if mesh.shape.get(dp_axis, 1) > 1 else None
 
     def grads_gpipe(pp_params, tokens, targets, positions):
@@ -715,7 +751,8 @@ def make_pp_train_step(model: TransformerLM,
             model, stage_fn, pp_params, tokens, targets, positions,
             n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
             dp_axis=dp, remat=remat, with_aux=moe, aux_weight=aux_weight,
-            fused_xent=fused_xent, xent_block=xent_block)
+            fused_xent=fused_xent, xent_block=xent_block,
+            n_virtual=n_virtual)
 
     def grads_1f1b(pp_params, tokens, targets, positions):
         return pp_1f1b_value_and_grad(
@@ -724,7 +761,9 @@ def make_pp_train_step(model: TransformerLM,
             dp_axis=dp, with_aux=moe, aux_weight=aux_weight,
             fused_xent=fused_xent, xent_block=xent_block)
 
-    grads_of = grads_gpipe if schedule == "gpipe" else grads_1f1b
+    # "interleaved" shares the autodiff path (pipeline_interleaved is
+    # selected inside pp_gpipe_value_and_grad by n_virtual > 1).
+    grads_of = grads_1f1b if schedule == "1f1b" else grads_gpipe
 
     def step(state: TrainState, tokens, targets, positions):
         loss, grads = grads_of(state.params, tokens, targets, positions)

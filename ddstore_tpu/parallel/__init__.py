@@ -9,7 +9,8 @@ value-add that connects the host-side store to device meshes.
 from .fsdp import fsdp_rules
 from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
                    make_mesh, replicate)
-from .pipeline import (pipeline_1f1b, pipeline_apply,
+from .pipeline import (interleave_stage_params, pipeline_1f1b,
+                       pipeline_apply, pipeline_interleaved,
                        stack_stage_params)
 from .ring_attention import ring_attention, ring_self_attention
 from .shuffle import (all_to_all_rows, global_shuffle_epoch,
@@ -37,5 +38,7 @@ __all__ = [
     "shardings_of",
     "pipeline_apply",
     "pipeline_1f1b",
+    "pipeline_interleaved",
+    "interleave_stage_params",
     "stack_stage_params",
 ]

@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "pipeline_1f1b", "stack_stage_params"]
+__all__ = ["pipeline_apply", "pipeline_1f1b", "pipeline_interleaved",
+           "stack_stage_params", "interleave_stage_params"]
 
 
 def _manual_axes(axis: str, dp_axis: Optional[str]):
@@ -119,6 +120,142 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
         if not with_aux:
             return outs
         aux = jax.lax.psum(auxs.sum(), axis) / m
+        if dp_axis is not None and mesh.shape.get(dp_axis, 1) > 1:
+            aux = jax.lax.pmean(aux, dp_axis)
+        return outs, aux
+
+    xspec = P(None, dp_axis) if dp_axis is not None else P()
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), xspec),
+        out_specs=(xspec, P()) if with_aux else xspec,
+        axis_names=_manual_axes(axis, dp_axis),
+        check_vma=False,
+    )(stage_params, x)
+
+
+def interleave_order(n_stages: int, n_virtual: int):
+    """THE device-major chunk order for :func:`pipeline_interleaved`:
+    ``order[p]`` is the model-order chunk held at stack position ``p``,
+    with position ``d·V + v`` holding chunk ``v·S + d``. Single source —
+    the model-side splitters (``lm_to_stages``/``lm_from_stages``) must
+    use this same list or devices would run the wrong chunks with no
+    shape error. Identity at V=1."""
+    return [v * n_stages + d for d in range(n_stages)
+            for v in range(n_virtual)]
+
+
+def interleave_stage_params(per_chunk_params, n_stages: int):
+    """Stack V·S per-chunk pytrees for :func:`pipeline_interleaved`.
+
+    Chunk ``k`` (model order) runs on device ``k mod S``; a plain
+    ``P(pp)`` shard of the stacked leading dim hands device ``d`` the
+    contiguous rows ``[d·V, (d+1)·V)``, so the stack must be built
+    device-major (see :func:`interleave_order`).
+    """
+    c = len(per_chunk_params)
+    if c % n_stages:
+        raise ValueError(
+            f"{c} chunks do not divide over {n_stages} stages")
+    order = interleave_order(n_stages, c // n_stages)
+    return stack_stage_params([per_chunk_params[k] for k in order])
+
+
+def pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
+                         mesh: Mesh, n_virtual: int, axis: str = "pp",
+                         dp_axis: Optional[str] = None,
+                         remat: bool = False, with_aux: bool = False):
+    """Interleaved virtual-stage pipeline (Megatron-style looping) — the
+    GPipe bubble ``(S-1)/(M+S-1)`` shrinks to ``(S-1)/(M·V+S-1)``.
+
+    The model is split into ``C = V·S`` chunks instead of S stages;
+    device ``d`` holds chunks ``{d, d+S, …, d+(V-1)S}``, so every
+    activation hop — including chunk ``vS+d`` → ``vS+d+1`` across the
+    wrap — is the same +1 ring ``ppermute``. Microbatches are injected
+    in groups of S, group ``g`` offset by ``g·V·S`` ticks; device ``d``
+    at tick ``t`` serves ``rel = t - d`` as group ``g = rel // VS``,
+    local chunk ``v = (rel mod VS) // S``, microbatch
+    ``i = g·S + rel mod S``. Each device is busy every tick of its
+    span (the V·S tick residues within a group are exactly
+    ``{j + vS}``), so the only idle time is the S-1-tick stagger —
+    per-tick work is 1/V of a stage, hence the V× smaller bubble.
+    ``n_virtual=1`` reduces to :func:`pipeline_apply`'s schedule.
+
+    stage_fn: ``(chunk_params, act) -> act`` (``(act, aux)`` under
+        ``with_aux``), activation shape chunk-invariant.
+    stage_params: pytree with leading dim ``V·S`` in DEVICE-MAJOR order
+        (build it with :func:`interleave_stage_params`), sharded over
+        ``axis``.
+    x: ``(M, mb, ...)`` microbatches, ``M`` divisible by S (pad the
+        microbatch count if needed); ``mb`` sharded over ``dp_axis``.
+    Returns ``(M, mb, ...)`` outputs (with ``with_aux``, ``(outputs,
+    aux)`` like :func:`pipeline_apply`). Reverse-mode differentiable;
+    the backward schedule is the scan reversed, with the same bubble.
+    """
+    s = mesh.shape[axis]
+    v = int(n_virtual)
+    if v < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
+    c = v * s
+    m = x.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != c:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != "
+                f"n_virtual*pp = {c}")
+    if m % s:
+        raise ValueError(
+            f"microbatch count {m} must be a multiple of the pp axis "
+            f"size {s} (groups of S share a V·S-tick span)")
+    if dp_axis is not None and x.shape[1] % mesh.shape[dp_axis]:
+        raise ValueError(
+            f"dp axis size {mesh.shape[dp_axis]} must divide microbatch "
+            f"size {x.shape[1]}")
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    ticks = m * v + s - 1
+
+    def body(params, xs):
+        d = jax.lax.axis_index(axis)
+        perm = [(j, (j + 1) % s) for j in range(s)]
+        buf = jnp.zeros(xs.shape[1:], xs.dtype)
+        # O(M) output accumulator instead of stacking all M·V+S-1 tick
+        # outputs (V× the GPipe stack for the same result).
+        out0 = jnp.zeros((m,) + xs.shape[1:], xs.dtype)
+
+        def sched(carry, t):
+            buf, outs, aux_acc = carry
+            rel = t - d
+            active = (rel >= 0) & (rel < m * v)
+            relc = jnp.clip(rel, 0, m * v - 1)
+            g = relc // (v * s)
+            vv = (relc % (v * s)) // s
+            i = g * s + relc % s
+            my = jax.tree_util.tree_map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, vv, 0, keepdims=False), params)
+            inject = jax.lax.dynamic_index_in_dim(xs, i, 0, keepdims=False)
+            a_in = jnp.where((d == 0) & (vv == 0), inject, buf)
+            if with_aux:
+                y, aux = fn(my, a_in)
+                aux_acc = aux_acc + jnp.where(
+                    active, aux.astype(jnp.float32), 0.0)
+            else:
+                y = fn(my, a_in)
+            final = active & (d == s - 1) & (vv == v - 1)
+            prev = jax.lax.dynamic_index_in_dim(outs, i, 0, keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(final, y, prev), i, 0)
+            return (jax.lax.ppermute(y, axis, perm), outs, aux_acc), None
+
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            sched, (buf, out0, jnp.zeros((), jnp.float32)),
+            jnp.arange(ticks))
+        # Only the last device wrote real rows (the `final` mask is
+        # device-gated); psum replicates them everywhere.
+        outs = jax.lax.psum(outs, axis)
+        if not with_aux:
+            return outs
+        aux = jax.lax.psum(aux_acc, axis) / m
         if dp_axis is not None and mesh.shape.get(dp_axis, 1) > 1:
             aux = jax.lax.pmean(aux, dp_axis)
         return outs, aux

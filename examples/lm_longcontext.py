@@ -46,8 +46,11 @@ def main():
                         "with --pp: stage stacks carry the TP sharding)")
     p.add_argument("--microbatches", type=int, default=2,
                    help="microbatches per step under --pp")
-    p.add_argument("--schedule", choices=("gpipe", "1f1b"),
+    p.add_argument("--schedule", choices=("gpipe", "1f1b", "interleaved"),
                    default="gpipe", help="pipeline schedule under --pp")
+    p.add_argument("--virtual-stages", type=int, default=2,
+                   help="model chunks per pp device under "
+                        "--schedule=interleaved (bubble shrinks V x)")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--remat-policy", type=str, default=None,
@@ -138,12 +141,14 @@ def main():
         if args.accum_steps != 1:
             raise SystemExit("--accum-steps composes with the sequential "
                              "step only; under --pp use --microbatches")
+        nv = args.virtual_stages if args.schedule == "interleaved" else 1
         state, tx = transformer.create_pp_train_state(
             jax.random.key(args.seed), model, n_stages=pp, lr=args.lr,
-            mesh=mesh)
+            mesh=mesh, n_virtual=nv)
         step = transformer.make_pp_train_step(
             model, tx, mesh, n_stages=pp,
-            n_microbatches=args.microbatches, schedule=args.schedule)
+            n_microbatches=args.microbatches, schedule=args.schedule,
+            n_virtual=nv)
         batch = args.microbatches * 2 * dp
     else:
         state, tx = transformer.create_train_state(

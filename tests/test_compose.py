@@ -48,13 +48,14 @@ def _seq_losses(steps=3, model=None):
 
 
 def _pp_losses(mesh, n_stages, n_micro, steps=3, schedule="gpipe",
-               model=None):
+               model=None, n_virtual=1):
     model = model or _model()
     state, tx = transformer.create_pp_train_state(
-        jax.random.key(0), model, n_stages, lr=1e-2, mesh=mesh)
+        jax.random.key(0), model, n_stages, lr=1e-2, mesh=mesh,
+        n_virtual=n_virtual)
     step = transformer.make_pp_train_step(
         model, tx, mesh, n_stages, n_micro, donate=False,
-        schedule=schedule)
+        schedule=schedule, n_virtual=n_virtual)
     tokens, targets, positions = _batch()
     losses = []
     for _ in range(steps):
@@ -64,7 +65,7 @@ def _pp_losses(mesh, n_stages, n_micro, steps=3, schedule="gpipe",
 
 
 def _assert_pp_grads_match(mesh, n_stages, n_micro, schedule="gpipe",
-                           model=None):
+                           model=None, n_virtual=1):
     """Pipelined gradients == sequential gradients on identical params,
     with the stage stacks carrying whatever tp sharding the mesh implies
     (the gradient, not the adam update, is the noise-honest oracle —
@@ -72,15 +73,17 @@ def _assert_pp_grads_match(mesh, n_stages, n_micro, schedule="gpipe",
     model = model or _model()
     tokens, targets, positions = _batch()
     params = model.init(jax.random.key(0), tokens, positions)
-    outer, stages = lm_to_stages(params, model.layers, n_stages)
-    stage_fn = transformer._make_stage_fn(model, n_stages, mesh=mesh)
+    outer, stages = lm_to_stages(params, model.layers, n_stages, n_virtual)
+    stage_fn = transformer._make_stage_fn(model, n_stages * n_virtual,
+                                          mesh=mesh)
     dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
 
     if schedule == "gpipe":
         def run(pp_params):
             return transformer.pp_gpipe_value_and_grad(
                 model, stage_fn, pp_params, tokens, targets, positions,
-                n_microbatches=n_micro, mesh=mesh, dp_axis=dp)
+                n_microbatches=n_micro, mesh=mesh, dp_axis=dp,
+                n_virtual=n_virtual)
 
         _, (g_o, g_st) = jax.jit(run)((outer, stages))
     else:
@@ -98,7 +101,7 @@ def _assert_pp_grads_match(mesh, n_stages, n_micro, schedule="gpipe",
             targets)
 
     g_seq = jax.jit(jax.grad(loss_seq))(params)
-    merged = lm_from_stages(g_o, g_st, model.layers, n_stages)
+    merged = lm_from_stages(g_o, g_st, model.layers, n_stages, n_virtual)
     got = dict(jax.tree_util.tree_leaves_with_path(merged))
     want = dict(jax.tree_util.tree_leaves_with_path(g_seq))
     assert got.keys() == want.keys()
@@ -365,3 +368,34 @@ def test_pp_ep_losses_match_and_sharded():
         pstate, loss = pstep(pstate, tokens, targets, positions)
         got.append(float(loss))
     np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
+
+
+# ---------------------------------------------------------------------------
+# interleaved × tp (and × sp): the interleaved schedule is manual over
+# pp/dp only, exactly like gpipe/1f1b, so megatron tp and the sp ring
+# ride through the chunked stacks unchanged.
+# ---------------------------------------------------------------------------
+
+
+def test_interleaved_tp_losses_match_sequential():
+    mesh = make_mesh({"pp": 2, "tp": 2})
+    got = _pp_losses(mesh, n_stages=2, n_micro=4,
+                     schedule="interleaved", n_virtual=2)
+    want = _seq_losses()
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_tp_grads_match():
+    mesh = make_mesh({"pp": 2, "tp": 2})
+    _assert_pp_grads_match(mesh, n_stages=2, n_micro=4, n_virtual=2)
+
+
+def test_interleaved_sp_losses_match_sequential():
+    """Ring attention inside each chunk (sequence over sp) under the
+    interleaved schedule."""
+    mesh = make_mesh({"pp": 2, "sp": 2})
+    model = _model(mesh=mesh)
+    got = _pp_losses(mesh, n_stages=2, n_micro=4, model=model,
+                     schedule="interleaved", n_virtual=2)
+    want = _seq_losses(model=_model())
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

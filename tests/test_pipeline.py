@@ -99,3 +99,119 @@ def test_pipeline_trains():
         upd, opt = tx.update(g, opt, p)
         p = optax.apply_updates(p, upd)
     assert float(loss_fn(p)) < l0 * 0.2
+
+
+# ---------------------------------------------------------------------------
+# Interleaved virtual stages (Megatron-style looping pipeline).
+# ---------------------------------------------------------------------------
+
+from ddstore_tpu.parallel import interleave_stage_params  # noqa: E402
+from ddstore_tpu.parallel.pipeline import pipeline_interleaved  # noqa: E402
+
+
+def _setup_chunks(s=4, v=2, m=8, mb=4, dim=16):
+    model = StageMLP(dim)
+    keys = jax.random.split(jax.random.key(3), s * v)
+    per_chunk = [model.init(k, jnp.zeros((mb, dim))) for k in keys]
+    stacked = interleave_stage_params(per_chunk, s)
+    x = jax.random.normal(jax.random.key(4), (m, mb, dim))
+    step = lambda p, a: model.apply(p, a)
+    return model, per_chunk, stacked, x, step
+
+
+def test_interleave_stage_params_order():
+    """Stack position d*V+v holds chunk v*S+d (device-major), so a P(pp)
+    shard hands each device its V chunks."""
+    s, v = 4, 2
+    chunks = [{"w": jnp.full((2,), float(k))} for k in range(s * v)]
+    st = interleave_stage_params(chunks, s)
+    for d in range(s):
+        for vv in range(v):
+            assert float(st["w"][d * v + vv][0]) == float(vv * s + d)
+
+
+def test_interleaved_forward_matches_sequential():
+    mesh = make_mesh({"pp": 4})
+    model, per_chunk, stacked, x, step = _setup_chunks()
+    out = jax.jit(lambda p, a: pipeline_interleaved(
+        step, p, a, mesh=mesh, n_virtual=2))(stacked, x)
+    want = _sequential(model, per_chunk, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_gradients_match_sequential():
+    mesh = make_mesh({"pp": 4})
+    model, per_chunk, stacked, x, step = _setup_chunks()
+    tgt = jax.random.normal(jax.random.key(5), x.shape)
+
+    def loss_pp(p, xx):
+        out = pipeline_interleaved(step, p, xx, mesh=mesh, n_virtual=2)
+        return jnp.mean((out - tgt) ** 2)
+
+    def loss_seq(ps, xx):
+        return jnp.mean((_sequential(model, ps, xx) - tgt) ** 2)
+
+    g_pp, gx_pp = jax.jit(jax.grad(loss_pp, argnums=(0, 1)))(stacked, x)
+    g_seq, gx_seq = jax.grad(loss_seq, argnums=(0, 1))(per_chunk, x)
+    g_seq_stacked = interleave_stage_params(g_seq, 4)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx_pp), np.asarray(gx_seq),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_interleaved_v1_equals_gpipe():
+    """n_virtual=1 must reproduce pipeline_apply exactly (the schedule
+    reduces to GPipe)."""
+    mesh = make_mesh({"pp": 4})
+    model, per_stage, stacked, x, step = _setup()
+    a = jax.jit(lambda p, xx: pipeline_interleaved(
+        step, p, xx, mesh=mesh, n_virtual=1))(stacked, x)
+    b = jax.jit(lambda p, xx: pipeline_apply(step, p, xx, mesh=mesh))(
+        stacked, x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0, rtol=0)
+
+
+def test_interleaved_with_dp_axis():
+    mesh = make_mesh({"pp": 4, "dp": 2})
+    model, per_chunk, stacked, x, step = _setup_chunks()
+    out = jax.jit(lambda p, a: pipeline_interleaved(
+        step, p, a, mesh=mesh, n_virtual=2, dp_axis="dp"))(stacked, x)
+    want = _sequential(model, per_chunk, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_with_aux_matches_sequential():
+    """Side losses (MoE-style) accumulate over all V*S chunks, averaged
+    over microbatches, identically to the sequential sum."""
+    mesh = make_mesh({"pp": 4})
+    model, per_chunk, stacked, x, _ = _setup_chunks()
+
+    def step_aux(p, a):
+        y = model.apply(p, a)
+        return y, jnp.mean(y ** 2)
+
+    out, aux = jax.jit(lambda p, a: pipeline_interleaved(
+        step_aux, p, a, mesh=mesh, n_virtual=2, with_aux=True))(stacked, x)
+    ys = [x.reshape(-1, x.shape[-1])]
+    for p in per_chunk:
+        ys.append(model.apply(p, ys[-1]))
+    want_aux = sum(float(jnp.mean(
+        y.reshape(x.shape[0], -1, x.shape[-1]) ** 2)) for y in ys[1:])
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ys[-1]).reshape(x.shape),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux), want_aux, rtol=1e-5)
+
+
+def test_interleaved_rejects_bad_shapes():
+    import pytest
+    mesh = make_mesh({"pp": 4})
+    model, per_chunk, stacked, x, step = _setup_chunks()
+    with pytest.raises(ValueError, match="multiple of the pp axis"):
+        pipeline_interleaved(step, stacked, x[:6], mesh=mesh, n_virtual=2)
+    with pytest.raises(ValueError, match="leading dim"):
+        pipeline_interleaved(step, stacked, x, mesh=mesh, n_virtual=3)

@@ -41,13 +41,14 @@ def test_stage_split_roundtrip():
 
 
 def _run_pp(mesh, n_stages, n_micro, steps=2, remat=False,
-            schedule="gpipe"):
+            schedule="gpipe", n_virtual=1):
     model = _model()
     state, tx = transformer.create_pp_train_state(
-        jax.random.key(0), model, n_stages, lr=1e-2, mesh=mesh)
+        jax.random.key(0), model, n_stages, lr=1e-2, mesh=mesh,
+        n_virtual=n_virtual)
     step = transformer.make_pp_train_step(
         model, tx, mesh, n_stages, n_micro, donate=False, remat=remat,
-        schedule=schedule)
+        schedule=schedule, n_virtual=n_virtual)
     tokens, targets, positions = _batch()
     losses = []
     for _ in range(steps):
@@ -416,3 +417,105 @@ def test_pp_fused_head_matches_unfused(schedule):
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat_r[path]), rtol=2e-4,
             atol=2e-5, err_msg=jax.tree_util.keystr(path))
+
+
+# ---------------------------------------------------------------------------
+# Interleaved virtual stages on the real LM (schedule="interleaved").
+# ---------------------------------------------------------------------------
+
+
+def test_interleaved_stage_split_roundtrip():
+    """Device-major chunk stack (V=2) splits and merges losslessly."""
+    model = _model()
+    params = model.init(jax.random.key(0), *(_batch()[0], _batch()[2]))
+    outer, stages = lm_to_stages(params, LAYERS, 2, n_virtual=2)
+    back = lm_from_stages(outer, stages, LAYERS, 2, n_virtual=2)
+    got = dict(jax.tree_util.tree_leaves_with_path(back))
+    want = dict(jax.tree_util.tree_leaves_with_path(params))
+    assert got.keys() == want.keys()
+    for k in want:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]), err_msg=str(k))
+
+
+def test_interleaved_lm_matches_sequential():
+    """schedule='interleaved' (S=2, V=2: 4 one-layer chunks) trains
+    identically to the sequential step."""
+    mesh = make_mesh({"pp": 2})
+    _, _, pp_losses = _run_pp(mesh, n_stages=2, n_micro=4,
+                              schedule="interleaved", n_virtual=2, steps=3)
+    _, seq_losses = _run_seq(steps=3)
+    np.testing.assert_allclose(pp_losses, seq_losses, atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_lm_gradients_exact():
+    """Full-model gradients through THE production interleaved path
+    (pp_gpipe_value_and_grad with n_virtual=2) == sequential gradients."""
+    model = _model()
+    tokens, targets, positions = _batch()
+    params = model.init(jax.random.key(0), tokens, positions)
+    n_stages, n_virtual = 2, 2
+    outer, stages = lm_to_stages(params, LAYERS, n_stages, n_virtual)
+    stage_fn = transformer._make_stage_fn(model, n_stages * n_virtual)
+
+    def run(pp_params):
+        return transformer.pp_gpipe_value_and_grad(
+            model, stage_fn, pp_params, tokens, targets, positions,
+            n_microbatches=4, mesh=make_mesh({"pp": 2}),
+            n_virtual=n_virtual)
+
+    def loss_seq(params):
+        return transformer.loss_fn(
+            model.apply(params, tokens, positions), targets)
+
+    (loss, (g_o, g_st)) = jax.jit(run)((outer, stages))
+    want_loss = loss_seq(params)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    g_seq = jax.jit(jax.grad(loss_seq))(params)
+    merged = lm_from_stages(g_o, g_st, model.layers, n_stages, n_virtual)
+    got = dict(jax.tree_util.tree_leaves_with_path(merged))
+    want = dict(jax.tree_util.tree_leaves_with_path(g_seq))
+    assert got.keys() == want.keys()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]),
+                                   atol=1e-5, rtol=1e-4, err_msg=str(k))
+
+
+def test_interleaved_dp_composition():
+    """dp×pp with interleave: microbatches over dp, V chunks per pp
+    device."""
+    mesh = make_mesh({"dp": 2, "pp": 2})
+    _, _, pp_losses = _run_pp(mesh, n_stages=2, n_micro=4,
+                              schedule="interleaved", n_virtual=2, steps=3)
+    _, seq_losses = _run_seq(steps=3)
+    np.testing.assert_allclose(pp_losses, seq_losses, atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_moe_train_step_runs():
+    """Interleaved schedule threads the MoE side loss (with_aux path)."""
+    model = transformer.TransformerLM(vocab=VOCAB, dim=DIM, heads=HEADS,
+                                      layers=LAYERS, n_experts=2,
+                                      compute_dtype=jnp.float32)
+    mesh = make_mesh({"pp": 2})
+    state, tx = transformer.create_pp_train_state(
+        jax.random.key(0), model, 2, lr=1e-2, mesh=mesh, n_virtual=2)
+    step = transformer.make_pp_train_step(
+        model, tx, mesh, 2, 4, donate=False, schedule="interleaved",
+        n_virtual=2)
+    tokens, targets, positions = _batch()
+    l0 = None
+    for _ in range(3):
+        state, loss = step(state, tokens, targets, positions)
+        assert np.isfinite(float(loss))
+        l0 = float(loss) if l0 is None else l0
+    assert float(loss) < l0
+
+
+def test_interleaved_rejects_n_virtual_elsewhere():
+    model = _model()
+    mesh = make_mesh({"pp": 2})
+    _, tx = transformer.create_pp_train_state(jax.random.key(0), model, 2,
+                                              mesh=mesh)
+    with pytest.raises(ValueError, match="interleaved"):
+        transformer.make_pp_train_step(model, tx, mesh, 2, 4,
+                                       schedule="gpipe", n_virtual=2)
