@@ -28,6 +28,7 @@ from ..ops.attention import flash_attention, mha_reference
 from ..parallel.pipeline import (interleave_order, pipeline_1f1b,
                                  pipeline_apply,
                                  pipeline_interleaved,
+                                 pipeline_interleaved_1f1b,
                                  stack_stage_params)
 from ..parallel.ring_attention import ring_attention
 from ..parallel.tp import (expert_rules, megatron_rules, shard_pytree,
@@ -654,14 +655,19 @@ def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
                            with_aux: bool = False,
                            aux_weight: float = 0.0,
                            fused_xent: bool = False,
-                           xent_block: int = 8192):
+                           xent_block: int = 8192,
+                           n_virtual: int = 1):
     """Loss + full-model gradients via the fused 1F1B schedule.
 
     Embedding runs outside the ring under ``jax.vjp`` (its gradient
     chains through the schedule's input cotangent); the LM head + loss
     run inside the last stage's schedule slot. This is THE production
     gradient path of ``make_pp_train_step(schedule="1f1b")`` — exactness
-    tests call it directly so they can't drift from what trains."""
+    tests call it directly so they can't drift from what trains. With
+    ``n_virtual > 1`` the ring runs
+    :func:`~ddstore_tpu.parallel.pipeline.pipeline_interleaved_1f1b`
+    (``schedule="interleaved_1f1b"``: 2V/(V+1)× smaller bubble AND the
+    M-independent stash; device-major stage stack required)."""
     outer, stages = pp_params
 
     def embed_f(embed_params):
@@ -677,10 +683,16 @@ def pp_1f1b_value_and_grad(model: TransformerLM, stage_fn, pp_params,
         return _head_xent(model, head_params, y, tgt, fused_xent,
                           xent_block)
 
-    loss, gstages, ghead, dxm = pipeline_1f1b(
-        stage_fn, head_loss, stages, outer["params"]["lmhead"], xm, tm,
-        mesh=mesh, axis=pp_axis, dp_axis=dp_axis, with_aux=with_aux,
-        aux_weight=aux_weight)
+    if n_virtual > 1:
+        loss, gstages, ghead, dxm = pipeline_interleaved_1f1b(
+            stage_fn, head_loss, stages, outer["params"]["lmhead"], xm,
+            tm, mesh=mesh, n_virtual=n_virtual, axis=pp_axis,
+            dp_axis=dp_axis, with_aux=with_aux, aux_weight=aux_weight)
+    else:
+        loss, gstages, ghead, dxm = pipeline_1f1b(
+            stage_fn, head_loss, stages, outer["params"]["lmhead"], xm,
+            tm, mesh=mesh, axis=pp_axis, dp_axis=dp_axis,
+            with_aux=with_aux, aux_weight=aux_weight)
     (gembed,) = embed_vjp(dxm.reshape(b, *dxm.shape[2:]))
     return loss, ({"params": {"embed": gembed, "lmhead": ghead}}, gstages)
 
@@ -714,6 +726,11 @@ def make_pp_train_step(model: TransformerLM,
       autodiff backward like gpipe. Requires a train state built with
       the same ``n_virtual`` (device-major chunk stack) and
       ``n_microbatches`` divisible by the pp axis size.
+    * ``"interleaved_1f1b"`` — :func:`pipeline_interleaved_1f1b`: both
+      wins at once (the Megatron production schedule) — the 1F1B
+      bubble shrinks a further ``2V/(V+1)``× AND the activation stash
+      is bounded by the chunk count, not the microbatch count. Same
+      state/microbatch requirements as ``"interleaved"``.
 
     MoE models (``n_experts > 0``) work under both schedules: the Switch
     load-balancing aux each block sows is threaded through the pipeline
@@ -725,12 +742,13 @@ def make_pp_train_step(model: TransformerLM,
     whereas the sequential step computes it over the whole batch at
     once; capacity clipping therefore sees microbatch-sized token sets.
     """
-    if schedule not in ("gpipe", "1f1b", "interleaved"):
+    if schedule not in ("gpipe", "1f1b", "interleaved",
+                        "interleaved_1f1b"):
         raise ValueError(f"unknown schedule: {schedule!r}")
-    if schedule != "interleaved" and n_virtual != 1:
+    if not schedule.startswith("interleaved") and n_virtual != 1:
         raise ValueError(
-            f"n_virtual={n_virtual} only applies to "
-            f"schedule='interleaved', got {schedule!r}")
+            f"n_virtual={n_virtual} only applies to the interleaved "
+            f"schedules, got {schedule!r}")
     if fused_xent is None:
         # THE same auto rule as lm_loss (>= 2 blocks or fusing is pure
         # overhead, and never under megatron TP — the head kernel is
@@ -759,11 +777,13 @@ def make_pp_train_step(model: TransformerLM,
             model, stage_fn, pp_params, tokens, targets, positions,
             n_microbatches=n_microbatches, mesh=mesh, pp_axis=pp_axis,
             dp_axis=dp, with_aux=moe, aux_weight=aux_weight,
-            fused_xent=fused_xent, xent_block=xent_block)
+            fused_xent=fused_xent, xent_block=xent_block,
+            n_virtual=n_virtual)
 
-    # "interleaved" shares the autodiff path (pipeline_interleaved is
-    # selected inside pp_gpipe_value_and_grad by n_virtual > 1).
-    grads_of = grads_1f1b if schedule == "1f1b" else grads_gpipe
+    # The value-and-grad helpers select the interleaved variants
+    # internally when n_virtual > 1, so routing is by backward style:
+    # autodiff (gpipe/interleaved) vs fused (1f1b/interleaved_1f1b).
+    grads_of = grads_1f1b if schedule.endswith("1f1b") else grads_gpipe
 
     def step(state: TrainState, tokens, targets, positions):
         loss, grads = grads_of(state.params, tokens, targets, positions)

@@ -9,8 +9,9 @@ value-add that connects the host-side store to device meshes.
 from .fsdp import fsdp_rules
 from .mesh import (batch_sharding, data_parallel_mesh, local_mesh,
                    make_mesh, replicate)
-from .pipeline import (interleave_stage_params, pipeline_1f1b,
-                       pipeline_apply, pipeline_interleaved,
+from .pipeline import (interleave_order, interleave_stage_params,
+                       pipeline_1f1b, pipeline_apply,
+                       pipeline_interleaved, pipeline_interleaved_1f1b,
                        stack_stage_params)
 from .ring_attention import ring_attention, ring_self_attention
 from .shuffle import (all_to_all_rows, global_shuffle_epoch,
@@ -39,6 +40,8 @@ __all__ = [
     "pipeline_apply",
     "pipeline_1f1b",
     "pipeline_interleaved",
+    "pipeline_interleaved_1f1b",
     "interleave_stage_params",
+    "interleave_order",
     "stack_stage_params",
 ]

@@ -33,7 +33,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["pipeline_apply", "pipeline_1f1b", "pipeline_interleaved",
-           "stack_stage_params", "interleave_stage_params"]
+           "pipeline_interleaved_1f1b",
+           "stack_stage_params", "interleave_stage_params",
+           "interleave_order"]
 
 
 def _manual_axes(axis: str, dp_axis: Optional[str]):
@@ -161,6 +163,34 @@ def interleave_stage_params(per_chunk_params, n_stages: int):
     return stack_stage_params([per_chunk_params[k] for k in order])
 
 
+def _check_interleave_args(s: int, n_virtual, stage_params, x, mesh: Mesh,
+                           dp_axis: Optional[str]):
+    """Shared argument validation for the two interleaved schedules.
+    Returns ``(v, c, m)``. The M-divisibility constraint applies only at
+    ``V > 1`` — ``V=1`` degenerates to the GPipe / plain-1F1B schedules,
+    which take any M (the group tiling is what constrains the genuinely
+    interleaved case)."""
+    v = int(n_virtual)
+    if v < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
+    c = v * s
+    m = x.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != c:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != "
+                f"n_virtual*pp = {c}")
+    if v > 1 and m % s:
+        raise ValueError(
+            f"microbatch count {m} must be a multiple of the pp axis "
+            f"size {s} (groups of S share a V·S-tick span)")
+    if dp_axis is not None and x.shape[1] % mesh.shape[dp_axis]:
+        raise ValueError(
+            f"dp axis size {mesh.shape[dp_axis]} must divide microbatch "
+            f"size {x.shape[1]}")
+    return v, c, m
+
+
 def pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
                          mesh: Mesh, n_virtual: int, axis: str = "pp",
                          dp_axis: Optional[str] = None,
@@ -193,24 +223,8 @@ def pipeline_interleaved(stage_fn: Callable, stage_params, x, *,
     the backward schedule is the scan reversed, with the same bubble.
     """
     s = mesh.shape[axis]
-    v = int(n_virtual)
-    if v < 1:
-        raise ValueError(f"n_virtual must be >= 1, got {n_virtual}")
-    c = v * s
-    m = x.shape[0]
-    for leaf in jax.tree_util.tree_leaves(stage_params):
-        if leaf.shape[0] != c:
-            raise ValueError(
-                f"stage_params leading dim {leaf.shape[0]} != "
-                f"n_virtual*pp = {c}")
-    if m % s:
-        raise ValueError(
-            f"microbatch count {m} must be a multiple of the pp axis "
-            f"size {s} (groups of S share a V·S-tick span)")
-    if dp_axis is not None and x.shape[1] % mesh.shape[dp_axis]:
-        raise ValueError(
-            f"dp axis size {mesh.shape[dp_axis]} must divide microbatch "
-            f"size {x.shape[1]}")
+    v, c, m = _check_interleave_args(s, n_virtual, stage_params, x, mesh,
+                                     dp_axis)
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
     ticks = m * v + s - 1
 
@@ -314,67 +328,109 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     exact for ``mean_i loss_fn(loss_params, stages(x_i), aux_i)`` and are
     already averaged over ``dp_axis``.
     """
+    # The fused schedule is the V=1 case of the interleaved one (the
+    # tick decode degenerates to f = t - stage / b = t - (2S-2-stage));
+    # one implementation, asserted tick-for-tick equivalent in
+    # tests/test_pipeline.py::test_interleaved_1f1b_v1_equals_1f1b.
+    return pipeline_interleaved_1f1b(
+        stage_fn, loss_fn, stage_params, loss_params, x, aux, mesh=mesh,
+        n_virtual=1, axis=axis, dp_axis=dp_axis, with_aux=with_aux,
+        aux_weight=aux_weight)
+
+
+def pipeline_interleaved_1f1b(stage_fn: Callable, loss_fn: Callable,
+                              stage_params, loss_params, x, aux, *,
+                              mesh: Mesh, n_virtual: int,
+                              axis: str = "pp",
+                              dp_axis: Optional[str] = None,
+                              with_aux: bool = False,
+                              aux_weight: float = 0.0):
+    """Fused interleaved 1F1B: virtual stages AND the fused
+    forward/backward schedule — the Megatron production combination.
+
+    Forward is :func:`pipeline_interleaved`'s schedule (chunk ``k`` of
+    microbatch ``i = g·S + j`` at tick ``τf = g·C + j + k`` on device
+    ``k mod S``, ``C = V·S``); the backward of ``(i, k)`` runs at
+    ``τb = g·C + j + 2(C-1) - k`` on the same device, its cotangent
+    hopping the -1 ring one chunk per tick. Both halves decode
+    uniquely from ``(t, d)``: the forward as in the interleaved
+    schedule, the backward via ``u = ⌊(t + d - 2(C-1)) / S⌋ = g·V - w``
+    with ``w ∈ [0, V)`` forcing ``g = ⌈u/V⌉``. Each tick every device
+    does one chunk-forward and one chunk-backward (recompute-p via
+    ``jax.vjp`` from the stashed chunk input, exactly like
+    :func:`pipeline_1f1b`); fill+drain is ``(V+1)S-2`` ticks of 1/V-
+    stage work versus plain 1F1B's ``(2S-2)·V`` — the bubble shrinks
+    by ``2V/(V+1)``×. The input stash is a ``2C-1``-slot ring (an
+    entry written at ``τf`` retires after ``2(C-1-k)`` ticks), so
+    activation memory is bounded by the chunk count: more than plain
+    1F1B's ``2S-1`` stage inputs, still independent of M — pick V so
+    ``2·V·S < M`` and both wins hold. ``n_virtual=1`` IS
+    :func:`pipeline_1f1b`'s schedule tick-for-tick.
+
+    Arguments and returns exactly as :func:`pipeline_1f1b`, except
+    ``stage_params`` carries the V·S device-major chunk stack (see
+    :func:`interleave_order`) and, for ``n_virtual > 1``, M must be a
+    multiple of the pp axis size (``V=1`` takes any M, like plain
+    1F1B).
+    """
     s = mesh.shape[axis]
-    m = x.shape[0]
-    for leaf in jax.tree_util.tree_leaves(stage_params):
-        if leaf.shape[0] != s:
-            raise ValueError(
-                f"stage_params leading dim {leaf.shape[0]} != pp axis "
-                f"size {s}")
-    if dp_axis is not None and x.shape[1] % mesh.shape[dp_axis]:
-        raise ValueError(
-            f"dp axis size {mesh.shape[dp_axis]} must divide microbatch "
-            f"size {x.shape[1]}")
+    v, c, m = _check_interleave_args(s, n_virtual, stage_params, x, mesh,
+                                     dp_axis)
 
     def body(params, lparams, xs, auxs):
-        stage = jax.lax.axis_index(axis)
-        last = s - 1
-        my = jax.tree_util.tree_map(lambda l: l[0], params)
+        d = jax.lax.axis_index(axis)
         fperm = [(j, (j + 1) % s) for j in range(s)]
         bperm = [(j, (j - 1) % s) for j in range(s)]
-        nstash = 2 * s - 1
-        ticks = m + 2 * s - 2
+        nstash = 2 * c - 1
+        ticks = m * v + c + s - 2
+
+        def sel(tree, idx):
+            return jax.tree_util.tree_map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, idx, 0, keepdims=False), tree)
 
         zerog = jax.tree_util.tree_map(
-            lambda l: jnp.zeros(l.shape, jnp.float32), my)
+            lambda l: jnp.zeros(l.shape, jnp.float32), params)
         zerolg = jax.tree_util.tree_map(
             lambda l: jnp.zeros(l.shape, jnp.float32), lparams)
         carry0 = (
             jnp.zeros((nstash,) + xs.shape[1:], xs.dtype),  # input stash
             jnp.zeros(xs.shape[1:], xs.dtype),              # fwd in-flight
             jnp.zeros(xs.shape[1:], xs.dtype),              # bwd in-flight
+            jnp.zeros((m,) + xs.shape[1:], xs.dtype),       # dx scatter
             zerog, zerolg,
             jnp.zeros((2,), jnp.float32),  # [head loss acc, side-aux acc]
         )
 
         def masked_add(pred, acc, delta):
             return jax.tree_util.tree_map(
-                lambda a, d: a + jnp.where(pred, d.astype(jnp.float32), 0.0),
+                lambda a, g: a + jnp.where(pred, g.astype(jnp.float32),
+                                           0.0),
                 acc, delta)
 
         def tick(carry, t):
-            stash, fwd_buf, bwd_buf, gacc, lgacc, lacc = carry
+            stash, fwd_buf, bwd_buf, dxacc, gacc, lgacc, lacc = carry
 
-            # -- forward half: microbatch f = t - stage ---------------------
-            f = t - stage
-            active_f = (f >= 0) & (f < m)
-            fidx = jnp.clip(f, 0, m - 1)
-            inject = jax.lax.dynamic_index_in_dim(xs, fidx, 0,
+            # -- forward half: the interleaved schedule's decode -------
+            rel = t - d
+            active_f = (rel >= 0) & (rel < m * v)
+            relc = jnp.clip(rel, 0, m * v - 1)
+            vv = (relc % c) // s
+            fi = (relc // c) * s + relc % s
+            my_f = sel(params, vv)
+            inject = jax.lax.dynamic_index_in_dim(xs, fi, 0,
                                                   keepdims=False)
-            a_in = jnp.where(stage == 0, inject, fwd_buf)
-            # Unconditional write is safe: a slot written at tick T0 is
-            # read at T0 + 2(S-1-stage) < T0 + nstash, before reuse.
+            a_in = jnp.where((d == 0) & (vv == 0), inject, fwd_buf)
             stash = jax.lax.dynamic_update_index_in_dim(
                 stash, a_in, jnp.mod(t, nstash), 0)
             if with_aux:
-                y, side = stage_fn(my, a_in)
+                y, side = stage_fn(my_f, a_in)
             else:
-                y = stage_fn(my, a_in)
+                y = stage_fn(my_f, a_in)
                 side = jnp.zeros((), jnp.float32)
 
-            # Loss + its cotangent exist only on the last stage; cond
-            # keeps the head/loss FLOPs off the other stages.
-            aux_mb = jax.lax.dynamic_index_in_dim(auxs, fidx, 0,
+            last_f = (d == s - 1) & (vv == v - 1)
+            aux_mb = jax.lax.dynamic_index_in_dim(auxs, fi, 0,
                                                   keepdims=False)
 
             def do_loss(args):
@@ -391,69 +447,71 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
                         jnp.zeros_like(yy))
 
             lval, dlp, dy_last = jax.lax.cond(
-                stage == last, do_loss, no_loss, (lparams, y, aux_mb))
+                last_f, do_loss, no_loss, (lparams, y, aux_mb))
 
-            # -- backward half: microbatch b = t - (2S-2-stage) -------------
-            b = t - (2 * s - 2 - stage)
-            active_b = (b >= 0) & (b < m)
-            bidx = jnp.clip(b, 0, m - 1)
-            # The stashed input for microbatch b was written at tick
-            # stage + b.
+            # -- backward half: τb = g·C + j + 2(C-1) - (w·S + d) ------
+            r = t + d - 2 * (c - 1)
+            jb = jnp.mod(r, s)
+            u = (r - jb) // s          # floor: = g·V - w
+            gb = (u + v - 1) // v      # ceil(u / V) — forces w ∈ [0, V)
+            w = gb * v - u
+            bi = gb * s + jb
+            active_b = (gb >= 0) & (bi < m)
+            wc = jnp.clip(w, 0, v - 1)
+            bic = jnp.clip(bi, 0, m - 1)
+            # The stashed input for (bi, w·S+d) was written at its
+            # forward tick g·C + j + k.
+            tf_b = gb * c + jb + w * s + d
             a_stash = jax.lax.dynamic_index_in_dim(
-                stash, jnp.mod(stage + bidx, nstash), 0, keepdims=False)
-            cot_in = jnp.where(stage == last, dy_last,
+                stash, jnp.mod(tf_b, nstash), 0, keepdims=False)
+            my_b = sel(params, wc)
+            cot_in = jnp.where((d == s - 1) & (w == v - 1), dy_last,
                                bwd_buf).astype(y.dtype)
-            _, svjp = jax.vjp(stage_fn, my, a_stash)
+            _, svjp = jax.vjp(stage_fn, my_b, a_stash)
             if with_aux:
-                # The side loss is additive per (stage, microbatch), so
-                # its gradient is a constant scalar cotangent on each
-                # backward — no cross-stage communication needed.
                 side_cot = jnp.where(active_b, aux_weight / m, 0.0)
                 dmy, da = svjp((cot_in, side_cot.astype(jnp.float32)))
             else:
                 dmy, da = svjp(cot_in)
 
-            gacc = masked_add(active_b, gacc, dmy)
-            lgacc = masked_add(active_f & (stage == last), lgacc, dlp)
+            gacc = jax.tree_util.tree_map(
+                lambda a, g: a.at[wc].add(
+                    jnp.where(active_b, g.astype(jnp.float32), 0.0)),
+                gacc, dmy)
+            lgacc = masked_add(active_f & last_f, lgacc, dlp)
             lacc = lacc + jnp.stack([
-                jnp.where(active_f & (stage == last),
-                          lval.astype(jnp.float32), 0.0),
+                jnp.where(active_f & last_f, lval.astype(jnp.float32),
+                          0.0),
                 jnp.where(active_f, side.astype(jnp.float32), 0.0),
             ])
+            # Chunk 0 (w == 0 on device 0) emits dL/dx for microbatch
+            # bi; scatter keeps the buffer O(M) instead of O(ticks).
+            prev = jax.lax.dynamic_index_in_dim(dxacc, bic, 0,
+                                                keepdims=False)
+            dxacc = jax.lax.dynamic_update_index_in_dim(
+                dxacc, jnp.where((d == 0) & (w == 0) & active_b, da,
+                                 prev), bic, 0)
 
             fwd_buf = jax.lax.ppermute(y, axis, fperm)
             bwd_buf = jax.lax.ppermute(da, axis, bperm)
-            # Keep dx in the activation dtype: the stacked per-tick
-            # output is the schedule's largest buffer, the psum only
-            # adds exact zeros from the other stages, and the later /dp
-            # is a power-of-two scale — f32 here would double it.
-            dx_out = jnp.where((stage == 0) & active_b, da,
-                               jnp.zeros_like(da))
-            return (stash, fwd_buf, bwd_buf, gacc, lgacc, lacc), dx_out
+            return (stash, fwd_buf, bwd_buf, dxacc, gacc, lgacc,
+                    lacc), None
 
-        final, dxs = jax.lax.scan(tick, carry0, jnp.arange(ticks))
-        (_, _, _, gacc, lgacc, lacc) = final
-        # Stage 0's dx for microbatch i lands at tick 2S-2+i; psum over pp
-        # replicates it (every other stage contributed zeros).
-        dx = jax.lax.psum(dxs[2 * s - 2:], axis)
+        final, _ = jax.lax.scan(tick, carry0, jnp.arange(ticks))
+        (_, _, _, dxacc, gacc, lgacc, lacc) = final
+        dx = jax.lax.psum(dxacc, axis)
         accs = jax.lax.psum(lacc, axis) / m
         loss = accs[0] + aux_weight * accs[1]
         lgrads = jax.tree_util.tree_map(lambda l: jax.lax.psum(l, axis),
                                         lgacc)
         if dp_axis is not None and mesh.shape.get(dp_axis, 1) > 1:
-            # Each dp replica saw a different slice of every microbatch;
-            # average, matching value_and_grad over the full batch.
             loss = jax.lax.pmean(loss, dp_axis)
             gacc = jax.tree_util.tree_map(
                 lambda l: jax.lax.pmean(l, dp_axis), gacc)
             lgrads = jax.tree_util.tree_map(
                 lambda l: jax.lax.pmean(l, dp_axis), lgrads)
-            # dx stays shard-local (x's mb dim is dp-sharded) but must be
-            # the gradient of the dp-AVERAGED loss, like everything else.
             dx = dx / mesh.shape[dp_axis]
-        # Re-add the stage dim so out_specs P(axis) scatters the stack.
-        gstack = jax.tree_util.tree_map(lambda l: l[None], gacc)
-        return loss, gstack, lgrads, dx
+        return loss, gacc, lgrads, dx
 
     xspec = P(None, dp_axis) if dp_axis is not None else P()
     loss_, gstack, lgrads, dx = jax.shard_map(
@@ -463,7 +521,6 @@ def pipeline_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
         axis_names=_manual_axes(axis, dp_axis),
         check_vma=False,
     )(stage_params, loss_params, x, aux)
-    # Gradients come back f32; match the parameter dtypes.
     gstack = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), gstack,
                                     stage_params)
     lgrads = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), lgrads,

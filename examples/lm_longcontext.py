@@ -46,11 +46,13 @@ def main():
                         "with --pp: stage stacks carry the TP sharding)")
     p.add_argument("--microbatches", type=int, default=2,
                    help="microbatches per step under --pp")
-    p.add_argument("--schedule", choices=("gpipe", "1f1b", "interleaved"),
+    p.add_argument("--schedule",
+                   choices=("gpipe", "1f1b", "interleaved",
+                            "interleaved_1f1b"),
                    default="gpipe", help="pipeline schedule under --pp")
     p.add_argument("--virtual-stages", type=int, default=2,
-                   help="model chunks per pp device under "
-                        "--schedule=interleaved (bubble shrinks V x)")
+                   help="model chunks per pp device under the "
+                        "interleaved schedules (bubble shrinks V x)")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--remat", action="store_true")
     p.add_argument("--remat-policy", type=str, default=None,
@@ -141,7 +143,8 @@ def main():
         if args.accum_steps != 1:
             raise SystemExit("--accum-steps composes with the sequential "
                              "step only; under --pp use --microbatches")
-        nv = args.virtual_stages if args.schedule == "interleaved" else 1
+        nv = args.virtual_stages \
+            if args.schedule.startswith("interleaved") else 1
         state, tx = transformer.create_pp_train_state(
             jax.random.key(args.seed), model, n_stages=pp, lr=args.lr,
             mesh=mesh, n_virtual=nv)
