@@ -65,13 +65,15 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
     argument; the teacher-forcing oracle (tests/test_decode.py) turns
     any drift between the two into a loud test failure.
 
-    MoE blocks decode with DROPLESS per-token top-1 routing: each token
-    goes to its argmax expert, no capacity clipping (a single decoded
-    token cannot meaningfully compete for sequence-level capacity).
-    Identical to the training forward wherever training dropped nothing;
-    positions training clipped to zero-output get their expert applied
-    instead — the standard train/infer asymmetry of capacity-factor
-    Switch layers."""
+    MoE blocks decode with DROPLESS per-token top-k routing (k =
+    ``model.moe_top_k``): each token goes to its k best experts, no
+    capacity clipping (a single decoded token cannot meaningfully
+    compete for sequence-level capacity). Gates match training: raw
+    router probability at k=1 (Switch), renormalized over the chosen k
+    otherwise (GShard). Identical to the training forward wherever
+    training dropped nothing; positions training clipped to zero-output
+    get their experts applied instead — the standard train/infer
+    asymmetry of capacity-factor MoE layers."""
     p = params["params"]
     dt = model.compute_dtype
     b = tokens.shape[0]
@@ -134,18 +136,21 @@ def decode_step(model: TransformerLM, params, cache: Cache, pos,
             rl = jnp.einsum("bd,de->be", h2.astype(jnp.float32),
                             mp["router"]["kernel"])
             probs = jax.nn.softmax(rl, axis=-1)
-            oh = jax.nn.one_hot(jnp.argmax(probs, axis=-1),
-                                model.n_experts, dtype=jnp.float32)
-            gate = jnp.sum(probs * oh, axis=-1)               # (B,)
-            # All-expert compute then one-hot select: E× the FLOPs of one
-            # expert, but static shapes and trivially small at S=1.
+            kk = model.moe_top_k
+            topv, topi = jax.lax.top_k(probs, kk)             # (B, k)
+            gates = topv if kk == 1 else \
+                topv / jnp.sum(topv, axis=-1, keepdims=True)
+            oh = jax.nn.one_hot(topi, model.n_experts,
+                                dtype=jnp.float32)            # (B, k, E)
+            # All-expert compute then one-hot combine: E× the FLOPs of
+            # one expert, but static shapes and trivially small at S=1.
             he = jnp.einsum("bd,edh->beh", h2.astype(dt),
                             mp["w1"].astype(dt))
             he = nn.relu(he + mp["b1"][None].astype(dt))
             oe = jnp.einsum("beh,ehd->bed", he, mp["w2"].astype(dt))
             oe = oe + mp["b2"][None].astype(dt)
-            y = jnp.einsum("bed,be->bd", oe.astype(jnp.float32), oh)
-            y = (y * gate[:, None]).astype(dt)
+            y = jnp.einsum("bed,bke,bk->bd", oe.astype(jnp.float32),
+                           oh, gates).astype(dt)
             x = x + y.reshape(b, 1, model.dim)
         else:
             h = nn.Dense(model.mlp_ratio * model.dim, dtype=dt).apply(
@@ -268,11 +273,30 @@ def generate(model: TransformerLM, params, prompt: jax.Array,
     # the prompt token by token (the greedy-vs-naive oracle pins it);
     # for MoE models the prefill applies TRAINING routing (capacity
     # clipping over the whole prompt), then cached steps are dropless —
-    # the same train/infer asymmetry decode_step documents.
-    pm = model.clone(mesh=prefill_mesh, remat=False, sow_kv=True)
+    # the same train/infer asymmetry decode_step documents. With
+    # prompt_lengths, pad positions are masked OUT of expert dispatch
+    # (token_mask below) so they consume no capacity, and — when the
+    # lengths are concrete — the per-expert capacity is computed from
+    # the REAL token count: routing is then invariant to the pad amount
+    # and matches the unpadded batch exactly. Traced lengths keep the
+    # padded-count capacity (capacity must be static), which is merely
+    # more generous; pads still cannot evict real tokens.
+    clone_kw = dict(mesh=prefill_mesh, remat=False, sow_kv=True)
+    tmask = None
+    if lengths is not None and model.n_experts > 0:
+        tmask = jnp.arange(plen)[None, :] < lengths[:, None]
+        if model.moe_capacity is None and \
+                not isinstance(lengths, jax.core.Tracer):
+            from .moe import default_capacity
+
+            nvalid = int(np.asarray(lengths).sum())
+            clone_kw["moe_capacity"] = default_capacity(
+                nvalid, model.n_experts, model.moe_top_k)
+    pm = model.clone(**clone_kw)
     positions = jnp.tile(jnp.arange(plen, dtype=jnp.int32), (b, 1))
     feats, inter = pm.apply(params, prompt, positions, True,
-                            mutable=("intermediates",))
+                            mutable=("intermediates",),
+                            token_mask=tmask)
     ks, vs = [], []
     for i in range(model.layers):
         (k, v), = inter["intermediates"][f"block{i}"]["kv"]

@@ -1,12 +1,33 @@
 """Mixture-of-experts layer with expert parallelism over the ``ep`` axis.
 
-Switch-style top-1 routing with a fixed per-expert capacity: tokens are
-dispatched to expert buffers with one-hot einsums (static shapes — no
-gather/scatter with data-dependent sizes), the expert FFNs are batched
-einsums over a leading expert dimension, and sharding that dimension over
-``ep`` (``parallel.tp.expert_rules``) makes XLA insert the all-to-alls of
+Top-k routing (Switch top-1 by default, GShard-style top-2+ optional)
+with a fixed per-expert capacity: tokens are dispatched to expert
+buffers with one-hot einsums (static shapes — no gather/scatter with
+data-dependent sizes), the expert FFNs are batched einsums over a
+leading expert dimension, and sharding that dimension over ``ep``
+(``parallel.tp.expert_rules``) makes XLA insert the all-to-alls of
 classic expert parallelism. Load balancing uses the standard Switch aux
-loss (fraction-routed × mean-router-prob, scaled by E).
+loss (fraction-routed × mean-router-prob, scaled by E; ==1 at uniform).
+
+Routing details:
+
+* ``top_k > 1``: each token is dispatched to its k highest-probability
+  experts with gates renormalized over the chosen k (``top_k=1`` keeps
+  the raw Switch gate, preserving the original top-1 numerics).
+  Capacity claims are CHOICE-MAJOR: every token's first choice is
+  placed before any token's second choice, so overflow drops
+  second-choice assignments first — the standard GShard priority.
+* ``capacity``: explicit per-expert buffer size overriding the
+  cf·k·T/E formula. ``capacity >= T`` makes routing dropless (each
+  token sends at most one assignment per expert, so no overflow is
+  possible). The one-pass MoE prefill (models/decode.py) uses this to
+  compute capacity from the REAL token count of a padded batch, so the
+  routing is invariant to how much padding the batch carries.
+* ``valid`` (optional (T,) bool): tokens marked False are excluded
+  from dispatch entirely — they consume no expert capacity, produce a
+  zero output row, and drop out of the aux-loss statistics. This is
+  how padded prompt positions are kept from evicting real tokens
+  during one-pass MoE prefill (models/decode.py).
 
 (EP is absent in the reference — SURVEY §2.2; with this module the
 framework covers the full dp/tp/pp/sp/ep set.)
@@ -14,11 +35,21 @@ framework covers the full dp/tp/pp/sp/ep set.)
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+
+def default_capacity(tokens: int, n_experts: int, top_k: int,
+                     capacity_factor: float = 2.0) -> int:
+    """THE per-expert buffer size rule: cf·k·T/E slots (k assignments
+    per token), capped at T (beyond that extra slots can never fill —
+    each token contributes at most one assignment per expert). Shared
+    by :class:`MoeMlp` and the prefill path so the two cannot drift."""
+    return min(tokens, max(1, int(capacity_factor * top_k * tokens
+                                  / n_experts)))
 
 
 class MoeMlp(nn.Module):
@@ -27,29 +58,48 @@ class MoeMlp(nn.Module):
     n_experts: int
     hidden: int
     capacity_factor: float = 2.0
+    top_k: int = 1
+    capacity: Optional[int] = None   # explicit override; >= T = dropless
     compute_dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+    def __call__(self, x, valid: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
         t, d = x.shape
         e = self.n_experts
-        cap = max(1, int(self.capacity_factor * t / e))
+        k = self.top_k
+        if not 1 <= k <= e:
+            raise ValueError(f"top_k={k} must be in [1, n_experts={e}]")
+        if self.capacity is not None and self.capacity < 1:
+            # cap=0 would silently zero every token's output.
+            raise ValueError(f"capacity={self.capacity} must be >= 1")
+        cap = min(t, self.capacity) if self.capacity is not None else \
+            default_capacity(t, e, k, self.capacity_factor)
         dt = self.compute_dtype
 
         # Router in f32 (tiny matmul; numerics matter more than speed).
         logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
                           name="router")(x.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)              # (T, E)
-        expert = jnp.argmax(probs, axis=-1)                  # (T,)
-        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+        probs = jax.nn.softmax(logits, axis=-1)             # (T, E)
+        topv, topi = jax.lax.top_k(probs, k)                # (T, k)
+        # top_k=1 keeps the raw router probability as the gate (Switch);
+        # k>1 renormalizes over the chosen experts (GShard).
+        gates = topv if k == 1 else \
+            topv / jnp.sum(topv, axis=-1, keepdims=True)
 
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)   # (T, E)
-        # 1-indexed arrival position of each token within its expert;
-        # tokens past capacity are dropped (standard Switch overflow).
-        pos = jnp.cumsum(onehot, axis=0) * onehot
+        oh = jax.nn.one_hot(topi, e, dtype=jnp.float32)     # (T, k, E)
+        if valid is not None:
+            oh = oh * valid.astype(jnp.float32)[:, None, None]
+        # Choice-major arrival order: flatten (k, T) with choice as the
+        # slow axis, so all first choices claim capacity before any
+        # second choice; 1-indexed position within each expert, tokens
+        # past capacity are dropped (standard overflow).
+        ohm = oh.transpose(1, 0, 2).reshape(k * t, e)
+        pos = jnp.cumsum(ohm, axis=0) * ohm
         keep = (pos > 0) & (pos <= cap)
-        dm = keep[..., None] * jax.nn.one_hot(                  # (T, E, C)
-            (pos - 1).astype(jnp.int32), cap, dtype=jnp.float32)
+        dm = (keep[..., None] * jax.nn.one_hot(             # (k, T, E, C)
+            (pos - 1).astype(jnp.int32), cap,
+            dtype=jnp.float32)).reshape(k, t, e, cap)
 
         w1 = self.param("w1", nn.initializers.lecun_normal(),
                         (e, d, self.hidden))
@@ -58,17 +108,24 @@ class MoeMlp(nn.Module):
                         (e, self.hidden, d))
         b2 = self.param("b2", nn.initializers.zeros, (e, d))
 
-        xin = jnp.einsum("tec,td->ecd", dm, x.astype(jnp.float32))
+        xin = jnp.einsum("ktec,td->ecd", dm, x.astype(jnp.float32))
         h = jnp.einsum("ecd,edh->ech", xin.astype(dt), w1.astype(dt))
         h = nn.relu(h + b1[:, None, :].astype(dt))
         out = jnp.einsum("ech,ehd->ecd", h, w2.astype(dt))
         out = out + b2[:, None, :].astype(dt)
-        combine = dm * gate[:, None, None]
+        combine = jnp.einsum("ktec,tk->tec", dm, gates)
         y = jnp.einsum("tec,ecd->td", combine,
                        out.astype(jnp.float32))
 
-        # Switch load-balancing loss: E * Σ_e f_e · p̄_e (==1 at uniform).
-        frac = onehot.mean(axis=0)
-        mean_prob = probs.mean(axis=0)
+        # Load-balancing loss: E · Σ_e f_e · p̄_e over VALID tokens,
+        # f_e counting all k assignments (==1 at uniform for any k).
+        if valid is None:
+            nvalid = jnp.float32(t)
+            mean_prob = probs.mean(axis=0)
+        else:
+            v = valid.astype(jnp.float32)
+            nvalid = jnp.maximum(v.sum(), 1.0)
+            mean_prob = (probs * v[:, None]).sum(axis=0) / nvalid
+        frac = oh.sum(axis=(0, 1)) / (nvalid * k)
         aux = e * jnp.sum(frac * mean_prob)
         return y.astype(x.dtype), aux

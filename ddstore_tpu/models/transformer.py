@@ -43,11 +43,13 @@ class Block(nn.Module):
     mesh: Optional[Mesh]
     sp_axis: str
     n_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity: Optional[int] = None
     sow_kv: bool = False  # stash per-layer K/V heads (decode prefill
     #                       seeds its cache from one full forward)
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, token_mask: Optional[jax.Array] = None):
         b, s, _ = x.shape
         dt = self.compute_dtype
         hd = self.dim // self.heads
@@ -77,9 +79,16 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(dt)
         if self.n_experts > 0:
             from .moe import MoeMlp
+            # token_mask (B, S) excludes padded positions from expert
+            # dispatch: they take no capacity and can't evict real
+            # tokens (one-pass MoE prefill over padded prompts).
+            vmask = None if token_mask is None else \
+                token_mask.reshape(b * s)
             y, aux = MoeMlp(self.n_experts, self.mlp_ratio * self.dim,
+                            top_k=self.moe_top_k,
+                            capacity=self.moe_capacity,
                             compute_dtype=dt, name="moe")(
-                h.reshape(b * s, self.dim))
+                h.reshape(b * s, self.dim), vmask)
             self.sow("intermediates", "moe_aux", aux)
             x = x + y.reshape(b, s, self.dim).astype(dt)
         else:
@@ -140,6 +149,11 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None   # enables ring attention when sp > 1
     sp_axis: str = "sp"
     n_experts: int = 0            # > 0 swaps the MLP for a switch-MoE
+    moe_top_k: int = 1            # experts per token (1=Switch, 2=GShard)
+    moe_capacity: Optional[int] = None  # explicit per-expert capacity
+    #                               (None: cf·k·T/E formula; the prefill
+    #                               sets it from the REAL token count of
+    #                               a padded batch)
     sow_kv: bool = False          # blocks stash K/V heads (decode prefill)
     remat: bool = False           # rematerialize blocks (long context:
     #                               trade recompute for activation memory)
@@ -151,11 +165,14 @@ class TransformerLM(nn.Module):
     #                               fraction of its recompute cost)
 
     @nn.compact
-    def __call__(self, tokens, positions, return_features: bool = False):
+    def __call__(self, tokens, positions, return_features: bool = False,
+                 *, token_mask: Optional[jax.Array] = None):
         """tokens/positions: (B, S) int32; positions are GLOBAL indices so
         sequence-sharded chunks embed correctly. ``return_features=True``
         returns the post-final-LayerNorm features instead of logits (the
-        fused-xent path applies the head kernel itself)."""
+        fused-xent path applies the head kernel itself). ``token_mask``
+        (B, S) bool marks real vs padded positions — only MoE routing
+        consumes it (padded tokens take no expert capacity)."""
         x = EmbedPE(self.vocab, self.dim, self.compute_dtype,
                     name="embed")(tokens, positions)
         if self.remat:
@@ -175,8 +192,11 @@ class TransformerLM(nn.Module):
         for i in range(self.layers):
             x = block_cls(self.dim, self.heads, self.mlp_ratio,
                           self.compute_dtype, self.mesh, self.sp_axis,
-                          n_experts=self.n_experts, sow_kv=self.sow_kv,
-                          name=f"block{i}")(x)
+                          n_experts=self.n_experts,
+                          moe_top_k=self.moe_top_k,
+                          moe_capacity=self.moe_capacity,
+                          sow_kv=self.sow_kv,
+                          name=f"block{i}")(x, token_mask)
         return LMHead(self.vocab, name="lmhead")(x, return_features)
 
 
@@ -531,7 +551,8 @@ def _make_stage_fn(model: "TransformerLM", n_stages: int,
                        and mesh.shape.get(model.sp_axis, 1) > 1) else None
     blk = Block(model.dim, model.heads, model.mlp_ratio,
                 model.compute_dtype, sp_mesh, model.sp_axis,
-                n_experts=model.n_experts)
+                n_experts=model.n_experts, moe_top_k=model.moe_top_k,
+                moe_capacity=model.moe_capacity)
 
     def stage_fn(stage_params, x):
         valid = stage_params["_valid"] > 0.5

@@ -917,10 +917,13 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   std::vector<Leaf> leaves;
   // A TCP bandwidth sample is only meaningful to the routing decision if
   // it measures traffic CMA could have carried instead: at least one
-  // single bulk-sized request to a CMA-capable (same-host) peer.
-  // Cross-host DCN reads would otherwise drag tcp_bulk_bw_ down and
-  // mask a genuinely faster same-host socket path.
+  // single bulk-sized request to a CMA-capable (same-host) peer, and NO
+  // cross-host leaves in the batch (the sample is bytes/wall-time over
+  // the whole batch — mixed batches would let DCN reads drag
+  // tcp_bulk_bw_ down and mask a genuinely faster same-host socket
+  // path, or inflate it when the DCN leaves parallelize).
   bool tcp_bulk_routable = false;
+  bool all_cma = true;
   for (int64_t ri = 0; ri < nreqs; ++ri) {
     const PeerReadV& rq = reqs[ri];
     if (rq.target < 0 || rq.target >= world_ || rq.target == rank_)
@@ -938,9 +941,11 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     // serving threads on the target.
     int64_t total = 0;
     for (int64_t i = 0; i < rq.n; ++i) total += rq.ops[i].nbytes;
-    if (total >= kBulkBytes) {
+    {
       std::lock_guard<std::mutex> lock(p.cma_mu);
-      tcp_bulk_routable = tcp_bulk_routable || p.cma_state == 1;
+      const bool cma_ok = p.cma_state == 1;
+      if (total >= kBulkBytes) tcp_bulk_routable |= cma_ok;
+      all_cma = all_cma && cma_ok;
     }
     if (nconn <= 1 ||
         (total < 2 * kStripeBytes && rq.n < 2 * nconn)) {
@@ -975,7 +980,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   group.Wait();
   for (int rc : rcs)
     if (rc != kOk) return rc;
-  if (tcp_bulk_routable) {
+  if (tcp_bulk_routable && all_cma) {
     int64_t tcp_bytes = 0;
     for (const Leaf& lf : leaves)
       for (const ReadOp& op : lf.ops) tcp_bytes += op.nbytes;

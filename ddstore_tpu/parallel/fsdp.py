@@ -31,6 +31,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["fsdp_rules", "fsdp_compose", "place_zero3", "data_axes"]
 
 
+def _lmhead_feature_spec(path, shape, size: int, axis: str):
+    """THE keep-vocab-whole rule for the LM head kernel, shared by
+    :func:`fsdp_rules` and :func:`fsdp_compose`: shard the feature dim
+    over ``axis`` (or replicate when it doesn't divide) — never the
+    vocab dim, whose shard would make the fused cross-entropy's
+    vocab-block scan gather the whole kernel every block. Returns None
+    when the leaf is not the head kernel (keyed on the full
+    lmhead/head/kernel path, not any module named "head")."""
+    if "lmhead" in set(path) and path[-2:] == ("head", "kernel") \
+            and len(shape) == 2:
+        return P(axis, None) if shape[0] % size == 0 else P()
+    return None
+
+
 def fsdp_rules(mesh: Mesh, axis: str = "fsdp") -> Callable:
     """Sharding rules for :func:`ddstore_tpu.parallel.tp.shard_pytree`.
 
@@ -46,15 +60,9 @@ def fsdp_rules(mesh: Mesh, axis: str = "fsdp") -> Callable:
         shape = getattr(leaf, "shape", ())
         if not shape:
             return P()
-        if "lmhead" in set(path) and path[-2:] == ("head", "kernel") \
-                and len(shape) == 2:
-            # Keep vocab whole for the fused head; if the feature dim
-            # doesn't divide, replicate rather than fall through to a
-            # vocab shard (which would make the fused scan gather the
-            # whole kernel every block). Keyed on the full
-            # lmhead/head/kernel path, not any module that happens to be
-            # named "head" (VERDICT r3 weak #6).
-            return P(axis, None) if shape[0] % size == 0 else P()
+        head = _lmhead_feature_spec(path, shape, size, axis)
+        if head is not None:
+            return head
         best = None
         for i, d in enumerate(shape):
             if d % size == 0 and d >= size:
@@ -109,9 +117,14 @@ def fsdp_compose(base_rules: Optional[Callable], mesh: Mesh,
     the largest base-unsharded dimension divisible by the fsdp axis size
     over ``axis``. A leaf with no such dimension keeps just its base
     spec — replication across fsdp of a tp-sharded leaf still holds
-    1/tp of it per device. The head kernel needs no special case here:
-    megatron already shards its vocab dim over tp (which disables the
-    fused-xent path), and fsdp then takes the feature dim.
+    1/tp of it per device. The LM head kernel keeps fsdp_rules' special
+    case whenever the base left it unsharded (fsdp×ep: expert rules
+    return P() for it): shard the FEATURE dim, never the vocab dim —
+    a vocab shard would make the fused cross-entropy's vocab-block scan
+    gather the whole kernel every block (the auto-enable check only
+    knows about tp). Under megatron TP the base already shards vocab
+    (which disables fused-xent) and fsdp takes the feature dim via the
+    general path.
     """
     size = mesh.shape[axis]
 
@@ -119,6 +132,10 @@ def fsdp_compose(base_rules: Optional[Callable], mesh: Mesh,
         shape = getattr(leaf, "shape", ())
         base = tuple(base_rules(path, leaf)) if base_rules else ()
         spec = list(base) + [None] * (len(shape) - len(base))
+        if all(s is None for s in spec):
+            head = _lmhead_feature_spec(path, shape, size, axis)
+            if head is not None:
+                return head
         best = None
         for i, d in enumerate(shape):
             if spec[i] is None and d % size == 0 and d >= size:

@@ -87,10 +87,27 @@ def restore_train_state(path: str, like: Any) -> Any:
 def save_shard(store, name: str, directory: str,
                chunk_rows: int = 65536) -> str:
     """Write this rank's shard of ``name`` to ``<dir>/<name>.r<rank>.bin``
-    with a JSON sidecar. Local-only IO; call on every rank."""
+    with a JSON sidecar. Local-only IO; call on every rank.
+
+    Every rank first removes rank files in ITS directory that the
+    CURRENT world cannot produce (rank index >= world). Without this, a
+    save at a smaller world leaves sidecars from an earlier larger-world
+    save behind, and a later resume at that larger world would silently
+    mix generations: ranks whose stale file "matches" their rank load
+    old bytes while the rest re-shard the new ones. Only files no live
+    rank owns are unlinked (``.w*`` elastic-restore scratch is spared —
+    a peer may hold a live mmap on it — and is never read by
+    :func:`load_shard`), so this cannot race concurrent writes; on a
+    shared dir the ranks' unlinks race only each other (idempotent).
+    Caveat: with NODE-LOCAL directories, files on nodes that left the
+    job can obviously not be cleaned — shrink-then-regrow resumes need
+    a shared directory (or empty dirs on the regrown nodes) to be safe;
+    :func:`load_shard` detects the mix via rank 0's sidecar only when
+    it can see it."""
     m = store._require(name)
     begin, end = store.my_row_range(name)
     os.makedirs(directory, exist_ok=True)
+    _unlink_stale(directory, name, store.world)
     stem = os.path.join(directory,
                         f"{name.replace('/', '_')}.r{store.rank}")
     with open(stem + ".bin", "wb") as f:
@@ -105,6 +122,26 @@ def save_shard(store, name: str, directory: str,
 
 def _stem(directory: str, name: str, rank: int) -> str:
     return os.path.join(directory, f"{name.replace('/', '_')}.r{rank}")
+
+
+def _unlink_stale(directory: str, name: str, world: int) -> None:
+    """Remove checkpoint rank files for ``name`` with rank index >=
+    ``world`` — files the current world can never rewrite. ``.w*``
+    elastic-restore scratch is deliberately NOT touched: a live rank may
+    be mmap-ing it as its tiered backing file (unlink under a remote
+    NFS mmap risks SIGBUS), and load_shard never reads ``.w*`` paths,
+    so stale ones are inert."""
+    import re
+
+    prefix = re.escape(name.replace("/", "_"))
+    pat = re.compile(rf"^{prefix}\.r(\d+)\.(bin|json)$")
+    for fn in os.listdir(directory):
+        mm = pat.match(fn)
+        if mm and int(mm.group(1)) >= world:
+            try:
+                os.unlink(os.path.join(directory, fn))
+            except FileNotFoundError:
+                pass
 
 
 def load_shard(store, name: str, directory: str, *,
@@ -126,12 +163,15 @@ def load_shard(store, name: str, directory: str, *,
     r = store.rank if rank is None else rank
     stem = _stem(directory, name, r)
     if rank is None:
-        # Every sidecar records the world it was saved under. Read this
-        # rank's OWN sidecar first — on node-local (non-shared) dirs it
-        # is the only one present — and fall back to r0's (which a
-        # shrunk shared-dir resume always has) when it's missing.
-        probe = stem if os.path.exists(stem + ".json") \
-            else _stem(directory, name, 0)
+        # Every sidecar records the world it was saved under. Rank 0's
+        # is AUTHORITATIVE — rank 0 participates in every save, so on a
+        # shared dir its sidecar is always the latest generation, while
+        # this rank's own file could be a stale leftover that
+        # save_shard's cleanup predates. Fall back to the own sidecar
+        # only when r0's is absent (node-local, non-shared dirs).
+        probe = _stem(directory, name, 0)
+        if not os.path.exists(probe + ".json"):
+            probe = stem
         with open(probe + ".json") as f:
             saved_world = json.load(f)["world"]
         if saved_world != store.world:
@@ -140,6 +180,13 @@ def load_shard(store, name: str, directory: str, *,
             return
     with open(stem + ".json") as f:
         meta = json.load(f)
+    if rank is None and meta["world"] != store.world:
+        # Own sidecar from a different generation than rank 0's: mixed
+        # checkpoint directory. Refusing beats serving stale bytes.
+        raise RuntimeError(
+            f"{stem}.json was saved at world={meta['world']} but rank 0's"
+            f" sidecar says world={store.world}: mixed checkpoint "
+            f"generations in {directory}")
     dtype = np.dtype(meta["dtype"])
     sample_shape = tuple(meta["sample_shape"])
     if mmap:
@@ -165,6 +212,15 @@ def _load_shard_resharded(store, name: str, directory: str,
     for i in range(saved_world):
         with open(_stem(directory, name, i) + ".json") as f:
             metas.append(json.load(f))
+        if metas[-1]["world"] != saved_world:
+            # A sidecar from a different save generation (e.g. a save
+            # that died between ranks): assembling it with the others
+            # would serve rows from two checkpoints as one dataset.
+            raise RuntimeError(
+                f"{_stem(directory, name, i)}.json was saved at world="
+                f"{metas[-1]['world']} but rank 0's sidecar says world="
+                f"{saved_world}: mixed checkpoint generations in "
+                f"{directory}")
     dtype = np.dtype(metas[0]["dtype"])
     sample_shape = tuple(metas[0]["sample_shape"])
     total = sum(m["nrows"] for m in metas)

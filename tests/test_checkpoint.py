@@ -212,3 +212,58 @@ def test_elastic_reshard_4_to_2_and_back(tmp_path):
     phase(8, "grow", save=False)              # 4 -> 8 (scale out)
     phase(2, "shrink-mmap", save=False, mmap=True)  # tiered elastic
     phase(3, "odd", save=False)               # uneven split boundaries
+
+
+def test_elastic_resave_invalidates_stale_generation(tmp_path):
+    """ADVICE r4 (medium): save@world=4, resume+RE-SAVE@world=2 (new
+    data generation), resume@world=4. Ranks 2-3 still find their own
+    world=4 sidecars from generation 1 on disk unless the smaller-world
+    save removed them — every rank must serve generation 2's bytes."""
+    rows_per, dim = 8, 2
+    total = 4 * rows_per
+    d = str(tmp_path / "gen")
+
+    def run(world, tag, body_fn):
+        name = f"gen-{tag}-{tmp_path.name}"
+        errs = []
+
+        def body(rank):
+            try:
+                g = ThreadGroup(name, rank, world)
+                with DDStore(g, backend="local") as s:
+                    body_fn(s, rank)
+                    s.barrier()
+            except Exception as e:  # pragma: no cover
+                import traceback
+                errs.append((rank, traceback.format_exc(), e))
+
+        ts = [threading.Thread(target=body, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert not errs, errs
+
+    def gen1(s, rank):  # world=4: value = global row index
+        shard = (np.arange(rows_per)[:, None] + rank * rows_per
+                 ) * np.ones((1, dim), np.float64)
+        s.add("v", shard)
+        save_shard(s, "v", d)
+
+    def gen2(s, rank):  # world=2: overwrite with value = index + 1000
+        per = total // 2
+        shard = (np.arange(per)[:, None] + rank * per + 1000.0
+                 ) * np.ones((1, dim), np.float64)
+        s.add("v", shard)
+        save_shard(s, "v", d)
+
+    def check(s, rank):  # world=4 again: generation 2 everywhere
+        load_shard(s, "v", d)
+        got = s.get_batch("v", np.arange(total))
+        want = (np.arange(total)[:, None] + 1000.0) * np.ones((1, dim))
+        np.testing.assert_array_equal(got, want)
+
+    run(4, "g1", gen1)
+    run(2, "g2", gen2)
+    run(4, "check", check)

@@ -189,3 +189,30 @@ def test_fsdp_gnn_matches_dp():
              for l in jax.tree.leaves(state.params)
              if getattr(l, "ndim", 0) >= 2}
     assert any("fsdp" in s for s in specs), specs
+
+
+def test_fsdp_ep_keeps_lmhead_vocab_whole():
+    """ADVICE r4: under fsdp×ep (no tp) the composed rules must shard the
+    LM head kernel's FEATURE dim, not the (larger) vocab dim — a vocab
+    shard would make the fused-xent vocab-block scan gather the whole
+    kernel every block."""
+    mesh = make_mesh({"fsdp": 2, "ep": 4})
+    model = transformer.TransformerLM(vocab=64, dim=32, heads=4, layers=2,
+                                      n_experts=4,
+                                      compute_dtype=jnp.float32)
+    state, tx = transformer.create_train_state(jax.random.key(0), model,
+                                               mesh=mesh)
+    head = state.params["params"]["lmhead"]["head"]["kernel"]
+    assert head.shape == (32, 64)
+    assert head.sharding.spec == jax.P("fsdp", None), head.sharding.spec
+    # experts still sharded over ep
+    w1 = state.params["params"]["block0"]["moe"]["w1"]
+    assert w1.sharding.spec[0] == "ep"
+    # and the composed state still trains
+    step = transformer.make_train_step(model, tx, mesh=mesh, state=state,
+                                       donate=False)
+    tok = jax.random.randint(jax.random.key(1), (4, 64), 0, 64, jnp.int32)
+    tgt = jnp.roll(tok, -1, axis=1)
+    pos = jnp.tile(jnp.arange(64, dtype=jnp.int32), (4, 1))
+    _, loss = step(state, tok, tgt, pos)
+    assert np.isfinite(float(loss))

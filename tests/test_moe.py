@@ -73,3 +73,182 @@ def test_moe_lm_trains():
         state, loss = step(state, tok, tgt, pos)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def _dense_mixture_oracle(params, x, top_k):
+    """Per-token explicit top-k mixture: what MoeMlp must equal when no
+    token overflows capacity."""
+    p = params["params"]
+    w_r = np.asarray(p["router"]["kernel"], np.float64)
+    w1 = np.asarray(p["w1"], np.float64)
+    b1 = np.asarray(p["b1"], np.float64)
+    w2 = np.asarray(p["w2"], np.float64)
+    b2 = np.asarray(p["b2"], np.float64)
+    xs = np.asarray(x, np.float64)
+    logits = xs @ w_r
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    out = np.zeros_like(xs)
+    for t in range(xs.shape[0]):
+        order = np.argsort(-probs[t], kind="stable")[:top_k]
+        g = probs[t, order]
+        if top_k > 1:
+            g = g / g.sum()
+        for gi, e in zip(g, order):
+            h = np.maximum(xs[t] @ w1[e] + b1[e], 0.0)
+            out[t] += gi * (h @ w2[e] + b2[e])
+    return out
+
+
+def test_topk2_matches_dense_mixture():
+    """top_k=2 with ample capacity == explicit two-expert mixture with
+    renormalized gates (the VERDICT r4 'oracle vs dense mixture' ask)."""
+    m = MoeMlp(n_experts=4, hidden=32, top_k=2, capacity_factor=8.0,
+               compute_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (48, 16))
+    params = m.init(jax.random.key(1), x)
+    y, aux = m.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               _dense_mixture_oracle(params, x, 2),
+                               atol=1e-4)
+    # aux stays the balanced-== 1 convention: uniform router -> aux == 1
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_topk1_dropless_matches_dense_mixture():
+    # capacity >= T makes routing dropless: exact top-1 mixture.
+    m = MoeMlp(n_experts=4, hidden=32, top_k=1, capacity=32,
+               compute_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(2), (32, 16))
+    params = m.init(jax.random.key(3), x)
+    y, _ = m.apply(params, x)
+    np.testing.assert_allclose(np.asarray(y),
+                               _dense_mixture_oracle(params, x, 1),
+                               atol=1e-4)
+
+
+def test_moe_pad_invariance_under_overflow():
+    """Masked pads + capacity computed from the REAL token count (the
+    decode-prefill recipe) == the unpadded batch exactly, even when
+    capacity is tight enough that real tokens drop."""
+    from ddstore_tpu.models.moe import default_capacity
+
+    e, h, d, nreal = 2, 8, 8, 12
+    x_real = jax.random.normal(jax.random.key(8), (nreal, d))
+    cap = default_capacity(nreal, e, 1, 0.25)
+    m_ref = MoeMlp(n_experts=e, hidden=h, capacity_factor=0.25,
+                   compute_dtype=jnp.float32)
+    params = m_ref.init(jax.random.key(9), x_real)
+    y_ref, _ = m_ref.apply(params, x_real)
+    assert cap * e < nreal  # capacity pressure: some tokens DO drop
+    assert (np.abs(np.asarray(y_ref)).sum(axis=1) == 0).any()
+
+    # Pad to 20 tokens with garbage interleaved mid-batch.
+    x_pad = jnp.concatenate([x_real[:5], 100.0 * jnp.ones((8, d)),
+                             x_real[5:]], axis=0)
+    valid = jnp.concatenate([jnp.ones(5, bool), jnp.zeros(8, bool),
+                             jnp.ones(nreal - 5, bool)])
+    m_pad = MoeMlp(n_experts=e, hidden=h, capacity=cap,
+                   compute_dtype=jnp.float32)
+    y_pad, _ = m_pad.apply(params, x_pad, valid)
+    got = np.concatenate([np.asarray(y_pad)[:5], np.asarray(y_pad)[13:]])
+    np.testing.assert_allclose(got, np.asarray(y_ref), atol=1e-5)
+
+
+def test_topk2_first_choices_have_priority():
+    """Choice-major capacity: when an expert overflows, second-choice
+    assignments are dropped before ANY first choice."""
+    m = MoeMlp(n_experts=2, hidden=8, top_k=2, capacity_factor=0.5,
+               compute_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(4), (16, 8))
+    params = m.init(jax.random.key(5), x)
+    # Recompute the routing exactly as the layer does.
+    w_r = np.asarray(params["params"]["router"]["kernel"], np.float32)
+    logits = np.asarray(x, np.float32) @ w_r
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    t, e, k = 16, 2, 2
+    cap = min(t, max(1, int(0.5 * k * t / e)))  # = 8
+    topi = np.argsort(-probs, axis=-1, kind="stable")[:, :k]
+    oh = np.zeros((t, k, e), np.float32)
+    for ti in range(t):
+        for ki in range(k):
+            oh[ti, ki, topi[ti, ki]] = 1.0
+    ohm = oh.transpose(1, 0, 2).reshape(k * t, e)
+    pos = np.cumsum(ohm, axis=0) * ohm
+    kept = ((pos > 0) & (pos <= cap)).reshape(k, t, e)
+    # Every first choice must be kept before any second choice is: if a
+    # second-choice assignment to expert E survives, then every first
+    # choice to E survives.
+    for ei in range(e):
+        if kept[1, :, ei].any():
+            assert kept[0, oh[:, 0, ei] > 0, ei].all()
+    # And with top-2 at cf=0.5 some second choices MUST drop.
+    assert (oh.sum() - kept.sum()) > 0
+
+
+def test_moe_valid_mask_frees_capacity():
+    """Padded (valid=False) tokens take no expert capacity: a real token
+    that overflowed in the padded run must be served once pads are
+    masked, and masked output rows are exactly zero."""
+    e, h, d, t = 2, 8, 8, 16
+    m = MoeMlp(n_experts=e, hidden=h, capacity_factor=0.25,
+               compute_dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(6), (t, d))
+    params = m.init(jax.random.key(7), x)
+    valid = jnp.arange(t) >= t // 2   # first half is "padding"
+    y_mask, _ = m.apply(params, x, valid)
+    # Masked rows produce zero.
+    assert np.abs(np.asarray(y_mask)[: t // 2]).sum() == 0
+    # Oracle: the layer applied to ONLY the valid tokens, with
+    # capacity_factor doubled so the absolute per-expert capacity
+    # (cf·k·T/E) matches the masked run's despite the halved T.
+    m_only = MoeMlp(n_experts=e, hidden=h, capacity_factor=0.5,
+                    compute_dtype=jnp.float32)
+    y_only, _ = m_only.apply(params, x[t // 2:])
+    np.testing.assert_allclose(np.asarray(y_mask)[t // 2:],
+                               np.asarray(y_only), atol=1e-5)
+    # And the mask matters: without it the pads' earlier arrival order
+    # steals capacity, changing at least one real token's output.
+    y_nomask, _ = m.apply(params, x)
+    assert np.abs(np.asarray(y_nomask)[t // 2:] -
+                  np.asarray(y_only)).max() > 1e-6
+
+
+def test_topk2_lm_trains_and_decodes():
+    """End-to-end: a top-2 MoE LM trains under ep sharding and its padded
+    vs unpadded generate() agree (the decode.py pad-capacity fix)."""
+    from ddstore_tpu.models import decode
+
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    model = transformer.TransformerLM(vocab=32, dim=32, heads=4, layers=2,
+                                      n_experts=4, moe_top_k=2,
+                                      compute_dtype=jnp.float32)
+    state, tx = transformer.create_train_state(jax.random.key(0), model,
+                                               lr=1e-3, mesh=mesh)
+    step = transformer.make_train_step(model, tx, mesh=mesh, state=state)
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 32, size=8)
+    corpus = np.tile(base, 200)
+    tok = jnp.asarray(np.stack([corpus[i:i + 64] for i in range(0, 512, 8)]),
+                      jnp.int32)[:8]
+    tgt = jnp.roll(tok, -1, axis=1)
+    pos = jnp.tile(jnp.arange(64, dtype=jnp.int32), (8, 1))
+    losses = []
+    for _ in range(30):
+        state, loss = step(state, tok, tgt, pos)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+    params = jax.device_get(state.params)
+    # Unpadded prompts of length 5 vs the same prompts right-padded to 9
+    # with GARBAGE: identical continuations (pads consume no capacity).
+    prompts = tok[:4, :5]
+    padded = jnp.concatenate(
+        [prompts, jnp.full((4, 4), 31, jnp.int32)], axis=1)
+    lens = jnp.full((4,), 5, jnp.int32)
+    out_plain = decode.generate(model, params, prompts, 6)
+    out_pad = decode.generate(model, params, padded, 6,
+                              prompt_lengths=lens)
+    np.testing.assert_array_equal(np.asarray(out_plain)[:, 5:],
+                                  np.asarray(out_pad)[:, 9:])
