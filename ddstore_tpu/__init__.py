@@ -22,6 +22,8 @@ Layers (bottom-up):
 """
 
 from .binding import DDStoreError, NativeStore, owner_of
+from .elastic import recover as elastic_recover
+from .elastic import rejoin as elastic_rejoin
 from .rendezvous import (FileGroup, JaxGroup, PodConfig, ProcessGroup,
                          SingleGroup, ThreadGroup, auto_group,
                          detect_pod_env, parse_nodelist, pod_bootstrap)
@@ -44,5 +46,7 @@ __all__ = [
     "detect_pod_env",
     "parse_nodelist",
     "pod_bootstrap",
+    "elastic_recover",
+    "elastic_rejoin",
     "__version__",
 ]

@@ -43,6 +43,13 @@ def _load() -> ctypes.CDLL:
     lib.dds_set_peers.argtypes = [ctypes.c_void_p,
                                   ctypes.POINTER(ctypes.c_char_p),
                                   ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.dds_update_peer.restype = ctypes.c_int
+    lib.dds_update_peer.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_char_p, ctypes.c_int]
+    lib.dds_barrier_seq.restype = _i64
+    lib.dds_barrier_seq.argtypes = [ctypes.c_void_p]
+    lib.dds_set_barrier_seq.restype = ctypes.c_int
+    lib.dds_set_barrier_seq.argtypes = [ctypes.c_void_p, _i64]
     lib.dds_add.restype = ctypes.c_int
     lib.dds_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
                             _i64, _i64, _i64, _i64p, ctypes.c_int]
@@ -162,6 +169,23 @@ class NativeStore:
         to them round-robin (multi-NIC striping, DDSTORE_IFACES)."""
         _check(self._lib.dds_set_ifaces(
             self._h, ",".join(addrs).encode()), "set_ifaces")
+
+    def update_peer(self, target: int, host: str, port: int) -> None:
+        """Elastic recovery: re-point one peer at a relaunched
+        replacement's endpoint (stale connections closed, CMA re-probed
+        against the new pid)."""
+        _check(self._lib.dds_update_peer(
+            self._h, target, host.encode(), port), f"update_peer({target})")
+
+    @property
+    def barrier_seq(self) -> int:
+        """The transport's collective sequence count (elastic rejoin
+        syncs a fresh rank to the group's)."""
+        return int(self._lib.dds_barrier_seq(self._h))
+
+    def set_barrier_seq(self, seq: int) -> None:
+        _check(self._lib.dds_set_barrier_seq(self._h, seq),
+               "set_barrier_seq")
 
     # -- data plane --------------------------------------------------------
 

@@ -61,6 +61,22 @@ int dds_set_peers(dds_handle* h, const char** hosts, const int* ports, int n) {
   return h->tcp->SetPeers(hs, ps);
 }
 
+int dds_update_peer(dds_handle* h, int target, const char* host_csv,
+                    int port) {
+  if (!h || !h->tcp || !host_csv) return dds::kErrInvalidArg;
+  return h->tcp->UpdatePeer(target, host_csv, port);
+}
+
+int64_t dds_barrier_seq(dds_handle* h) {
+  return h && h->tcp ? h->tcp->barrier_seq() : -1;
+}
+
+int dds_set_barrier_seq(dds_handle* h, int64_t seq) {
+  if (!h || !h->tcp) return dds::kErrInvalidArg;
+  h->tcp->SetBarrierSeq(seq);
+  return dds::kOk;
+}
+
 int dds_add(dds_handle* h, const char* name, const void* buf, int64_t nrows,
             int64_t disp, int64_t itemsize, const int64_t* all_nrows,
             int copy) {

@@ -335,6 +335,53 @@ int TcpTransport::SetPeers(const std::vector<std::string>& hosts,
   return kOk;
 }
 
+int64_t TcpTransport::barrier_seq() {
+  std::lock_guard<std::mutex> lock(barrier_mu_);
+  return barrier_seq_;
+}
+
+void TcpTransport::SetBarrierSeq(int64_t seq) {
+  std::lock_guard<std::mutex> lock(barrier_mu_);
+  if (seq > barrier_seq_) barrier_seq_ = seq;
+  // Also retire everything at or below: any notify a peer sent for an
+  // older collective belongs to a barrier this rank never ran.
+  if (seq > retired_seq_) retired_seq_ = seq;
+}
+
+int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
+                             int port) {
+  if (target < 0 || target >= world_ || target == rank_)
+    return kErrInvalidArg;
+  std::vector<std::string> hosts = SplitCsv(host_csv);
+  if (hosts.empty()) return kErrInvalidArg;
+  Peer& p = *peers_[target];
+  {
+    // Hold EVERY conn mutex while swapping the endpoint: EnsureConnected
+    // reads p.hosts/p.port under its conn's mutex, so this excludes all
+    // concurrent users (an in-flight read blocked on the dead fd holds
+    // its mutex only until its bounded timeout fires).
+    std::vector<std::unique_lock<std::mutex>> locks;
+    locks.reserve(p.conns.size());
+    for (auto& c : p.conns) locks.emplace_back(c->mu);
+    for (auto& c : p.conns) {
+      if (c->fd >= 0) {
+        ::close(c->fd);
+        c->fd = -1;
+      }
+    }
+    p.hosts = std::move(hosts);
+    p.port = port;
+  }
+  {
+    // The replacement is a different process: its CMA mapping table and
+    // pid are new, so force a fresh probe on the next read.
+    std::lock_guard<std::mutex> lock(p.cma_mu);
+    p.cma_state = 0;
+    p.cma.reset();
+  }
+  return kOk;
+}
+
 void TcpTransport::AcceptLoop() {
   while (!stopping_.load()) {
     sockaddr_in cli;

@@ -71,6 +71,22 @@ class TcpTransport : public Transport {
   int SetPeers(const std::vector<std::string>& hosts,
                const std::vector<int>& ports);
 
+  // Elastic recovery: the dissemination barrier matches notifies by the
+  // transport's own collective sequence number, so a rejoined rank must
+  // adopt the group's current count before its first barrier. Survivors
+  // report theirs (identical across them — collectives are lockstep);
+  // everyone adopts the max (a no-op for survivors).
+  int64_t barrier_seq();
+  void SetBarrierSeq(int64_t seq);
+
+  // Elastic recovery: re-point ONE peer at a new endpoint (a relaunched
+  // replacement process — the in-run half of SURVEY §5's "elastic
+  // recovery", where the reference exits fatally, common.cxx:100-111).
+  // Closes the peer's pooled connections (they belonged to the dead
+  // process) and resets its CMA state so the next read reconnects to
+  // the new endpoint and re-probes the new pid.
+  int UpdatePeer(int target, const std::string& host_csv, int port);
+
   // Local source addresses (one per NIC) to bind outgoing connections to,
   // round-robin by pool index; empty = kernel default. Mirrors
   // DDSTORE_IFACES on the receive side of the same NIC-spreading story.

@@ -26,6 +26,12 @@ from .rendezvous import (FileGroup, JaxGroup, ProcessGroup, SingleGroup,
 __all__ = ["DDStore", "DDStoreError"]
 
 
+def _row_disp(sample_shape: Tuple[int, ...]) -> int:
+    """Row displacement (elements per sample) — THE single derivation
+    shared by add/init/add_mmap and the elastic rejoin path."""
+    return int(np.prod(sample_shape, dtype=np.int64)) if sample_shape else 1
+
+
 def _my_host() -> str:
     host = os.environ.get("DDSTORE_HOST")
     if host:
@@ -148,6 +154,12 @@ class DDStore:
         self._barrier_tag = 1 << 32  # distinct from epoch tags
 
         rank, world = self.group.rank, self.group.size
+        # Elastic-recovery bookkeeping (ddstore_tpu.elastic): which
+        # endpoint each peer currently lives at, what this rank
+        # advertises, and how many recovery generations have committed.
+        self._advertised = None
+        self._endpoints = None
+        self._generation = 0
         if backend == "local":
             gid = self.group.broadcast(uuid.uuid4().hex)
             self._gid = gid
@@ -167,6 +179,8 @@ class DDStore:
             self._native.set_peers(hosts, ports)
             if ifaces:
                 self._native.set_ifaces(ifaces)
+            self._advertised = advertised
+            self._endpoints = [tuple(e) for e in endpoints]
         else:
             raise ValueError(f"unknown backend: {backend}")
         self._native.set_epoch_collective(epoch_collective)
@@ -187,7 +201,7 @@ class DDStore:
             raise ValueError("shard must have a leading sample dimension")
         nrows = arr.shape[0]
         sample_shape = tuple(arr.shape[1:])
-        disp = int(np.prod(sample_shape, dtype=np.int64)) if sample_shape else 1
+        disp = _row_disp(sample_shape)
         metas = self.group.allgather(
             (nrows, arr.dtype.str, sample_shape))
         shapes = {(d, s) for _, d, s in metas}
@@ -209,7 +223,7 @@ class DDStore:
         """Register a zero-filled shard for deferred population (reference
         ``init``, pyddstore.pyx:112-113)."""
         dtype = np.dtype(dtype)
-        disp = int(np.prod(sample_shape, dtype=np.int64)) if sample_shape else 1
+        disp = _row_disp(tuple(sample_shape))
         metas = self.group.allgather((int(nrows), dtype.str,
                                       tuple(sample_shape)))
         shapes = {(d, s) for _, d, s in metas}
@@ -287,8 +301,7 @@ class DDStore:
         """Register a file-backed shard (collective). ``nrows`` is inferred
         from the file size; ``mode="r+"`` keeps ``update`` usable."""
         dtype = np.dtype(dtype)
-        disp = int(np.prod(sample_shape, dtype=np.int64)) if sample_shape \
-            else 1
+        disp = _row_disp(tuple(sample_shape))
         row_bytes = disp * dtype.itemsize
         size = os.path.getsize(path)
         if size % row_bytes:
