@@ -180,6 +180,13 @@ def _tcp_worker(rank, world, rdv, outfile, num, dim):
                     res["auto_stripe_gbps"] = best_bw(
                         lambda: s.get("bench", num, nrows, out=shard_dst),
                         nrows * dim * 8, reps=4)
+                    # Routing observability (VERDICT r4 next #8): the
+                    # adaptive state lands in bench extras so a future
+                    # routing regression (flapping, a parked-wrong
+                    # preference) is diagnosable from the JSON alone.
+                    for k, v in s._native.routing_state().items():
+                        res[f"route_{k}"] = round(v, 3) \
+                            if isinstance(v, float) else v
             s.barrier()
             # Fence latency: everyone participates, rank 0 times it.
             t0 = time.perf_counter()
@@ -264,7 +271,12 @@ def tcp_microbench(world=4, num=65536, dim=64):
          {"tcp_get_p50_us": "cma_get_p50_us",
           "tcp_stripe_gbps": "cma_stripe_gbps",
           "tcp_batch_gbps": "cma_batch_gbps",
-          "auto_stripe_gbps": "cma_auto_stripe_gbps"}),
+          "auto_stripe_gbps": "cma_auto_stripe_gbps",
+          "route_cma_bulk_gbps": "route_cma_bulk_gbps",
+          "route_tcp_bulk_gbps": "route_tcp_bulk_gbps",
+          "route_bulk_decisions": "route_bulk_decisions",
+          "route_bulk_crossovers": "route_bulk_crossovers",
+          "route_bulk_via_tcp": "route_bulk_via_tcp"}),
     )
     for env, keys in passes:
         rdv = tempfile.mkdtemp()
@@ -721,6 +733,42 @@ def gnn_pipeline_bench(graphs=4096, graphs_per_slot=8, warm_epochs=1,
 # ---------------------------------------------------------------------------
 
 
+def profile_lm_long(outdir, steps=3):
+    """Op-level trace of the long-context train step (VERDICT r4 next
+    #2: the ~100 ms gap between the full step and fwd+bwd is only
+    attributable from a real profile). Writes a jax.profiler trace
+    (xplane + trace-viewer json) under ``outdir``; view with
+    tensorboard or xprof."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddstore_tpu.models import transformer
+
+    on_tpu = jax.default_backend() == "tpu"
+    vocab, dim, heads, layers, b, s = (32768, 1024, 16, 8, 2, 8192) \
+        if on_tpu else (256, 64, 4, 2, 2, 128)
+    model = transformer.TransformerLM(vocab=vocab, dim=dim, heads=heads,
+                                      layers=layers,
+                                      compute_dtype=jnp.bfloat16)
+    state, tx = transformer.create_train_state(jax.random.key(0), model)
+    # THE production step (donated buffers), not the fori_loop harness:
+    # per-op attribution should map onto one real step.
+    step = transformer.make_train_step(model, tx)
+    k1, k2 = jax.random.split(jax.random.key(1))
+    tokens = jax.random.randint(k1, (b, s), 0, vocab)
+    targets = jax.random.randint(k2, (b, s), 0, vocab)
+    positions = jnp.tile(jnp.arange(s), (b, 1))
+    state, loss = step(state, tokens, targets, positions)  # compile+warm
+    jax.block_until_ready(loss)
+    with jax.profiler.trace(outdir):
+        for _ in range(steps):
+            state, loss = step(state, tokens, targets, positions)
+        jax.block_until_ready(loss)
+    print(f"# profile: {steps} steps of ({b},{s}) vocab={vocab} on "
+          f"{jax.devices()[0].device_kind} -> {outdir}", file=sys.stderr)
+
+
 def _phase_local():
     p50, gbps = store_microbench()
     print(f"# local store: single-get p50={p50 * 1e6:.1f}us "
@@ -733,6 +781,22 @@ def _phase_tcp():
     tcp = tcp_microbench()
     print(f"# tcp store: {tcp}", file=sys.stderr)
     return {k: round(v, 3) for k, v in tcp.items()}
+
+
+def _phase_soak():
+    # Shared harness with tests/test_tiering.py (VERDICT r4 next #5) —
+    # the bench and the regression test measure the SAME soak.
+    from ddstore_tpu.utils.soak import mmap_soak
+
+    m = mmap_soak()
+    print(f"# tiering soak: {m['rows']:.0e}-row mmap shard, "
+          f"{m['rows_per_s']:.0f} rows/s batched, RSS "
+          f"+{m['rss_delta_mb']:.0f} MB, sentinels "
+          f"{'ok' if m['sentinels_ok'] else 'BAD'}", file=sys.stderr)
+    return {"soak_rows": m["rows"],
+            "soak_rows_per_s": round(m["rows_per_s"], 0),
+            "soak_rss_delta_mb": round(m["rss_delta_mb"], 1),
+            "soak_sentinels_ok": m["sentinels_ok"]}
 
 
 def _phase_vae():
@@ -784,6 +848,7 @@ def _phase_attnlong():
 
 
 _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
+           ("soak", _phase_soak),
            ("vae", _phase_vae), ("gnn", _phase_gnn),
            ("numerics", _phase_numerics), ("lm", _phase_lm),
            ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong))
@@ -791,6 +856,17 @@ _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
 
 def main():
     import subprocess
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "--profile":
+        # Same platform pin as --phase: the site hook can pre-register a
+        # TPU platform that overrides the JAX_PLATFORMS env var (and a
+        # wedged tunnel then hangs every device call).
+        if plat := os.environ.get("JAX_PLATFORMS"):
+            import jax
+            jax.config.update("jax_platforms", plat)
+        outdir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/ddstore_trace"
+        profile_lm_long(outdir)
+        return
 
     if len(sys.argv) == 3 and sys.argv[1] == "--phase":
         # A site hook in this image can pre-register a TPU platform at

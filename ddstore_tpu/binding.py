@@ -48,6 +48,11 @@ def _load() -> ctypes.CDLL:
                                     ctypes.c_char_p, ctypes.c_int]
     lib.dds_barrier_seq.restype = _i64
     lib.dds_barrier_seq.argtypes = [ctypes.c_void_p]
+    lib.dds_routing_state.restype = ctypes.c_int
+    lib.dds_routing_state.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double), _i64p, _i64p,
+        ctypes.POINTER(ctypes.c_int)]
     lib.dds_set_barrier_seq.restype = ctypes.c_int
     lib.dds_set_barrier_seq.argtypes = [ctypes.c_void_p, _i64]
     lib.dds_add.restype = ctypes.c_int
@@ -176,6 +181,26 @@ class NativeStore:
         against the new pid)."""
         _check(self._lib.dds_update_peer(
             self._h, target, host.encode(), port), f"update_peer({target})")
+
+    def routing_state(self) -> dict:
+        """Adaptive bulk-routing snapshot: per-path EWMA bandwidths,
+        decision/probe counts, crossovers, current preference —
+        exported into bench extras so routing regressions are
+        diagnosable from the BENCH json alone."""
+        cma = ctypes.c_double()
+        tcp = ctypes.c_double()
+        dec = ctypes.c_int64()
+        cro = ctypes.c_int64()
+        via = ctypes.c_int()
+        _check(self._lib.dds_routing_state(
+            self._h, ctypes.byref(cma), ctypes.byref(tcp),
+            ctypes.byref(dec), ctypes.byref(cro), ctypes.byref(via)),
+            "routing_state")
+        return {"cma_bulk_gbps": cma.value / 1e9,
+                "tcp_bulk_gbps": tcp.value / 1e9,
+                "bulk_decisions": dec.value,
+                "bulk_crossovers": cro.value,
+                "bulk_via_tcp": bool(via.value)}
 
     @property
     def barrier_seq(self) -> int:

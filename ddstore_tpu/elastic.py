@@ -122,13 +122,14 @@ def _sync_state(store: DDStore, group, *, joiner: bool,
         return joiners
     if ckpt_dir is None:
         raise ValueError("rejoin() needs ckpt_dir to rebuild the shard")
+    from .utils.checkpoint import _stem
+
     for name in sorted(ref):
         dt, sshape, all_nrows = ref[name]
         dtype = np.dtype(dt)
         sample_shape = tuple(sshape)
         nrows = int(all_nrows[store.rank])
-        stem = os.path.join(ckpt_dir,
-                            f"{name.replace('/', '_')}.r{store.rank}")
+        stem = _stem(ckpt_dir, name, store.rank)
         if nrows:
             try:
                 with open(stem + ".json") as f:
@@ -175,6 +176,13 @@ def recover(store: DDStore, root: str,
     generation's."""
     if store._endpoints is None:
         raise ValueError("recover() requires the tcp backend")
+    if store.group is not store.world_group:
+        # width=... replica-split stores: the generation bookkeeping in
+        # `root` is one sequence, not one per replica group — two
+        # replicas recovering would cross-wire each other's rendezvous.
+        raise ValueError("elastic recovery does not support replica-"
+                         "split (width=...) stores yet; recover the "
+                         "full-world store")
     if timeout is None:
         timeout = _default_timeout()
     gen = store._generation + 1

@@ -67,6 +67,14 @@ int dds_update_peer(dds_handle* h, int target, const char* host_csv,
   return h->tcp->UpdatePeer(target, host_csv, port);
 }
 
+int dds_routing_state(dds_handle* h, double* cma_bw, double* tcp_bw,
+                      int64_t* decisions, int64_t* crossovers,
+                      int* via_tcp) {
+  if (!h || !h->tcp) return dds::kErrInvalidArg;
+  h->tcp->RoutingState(cma_bw, tcp_bw, decisions, crossovers, via_tcp);
+  return dds::kOk;
+}
+
 int64_t dds_barrier_seq(dds_handle* h) {
   return h && h->tcp ? h->tcp->barrier_seq() : -1;
 }

@@ -374,10 +374,13 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
   }
   {
     // The replacement is a different process: its CMA mapping table and
-    // pid are new, so force a fresh probe on the next read.
+    // pid are new, so force a fresh probe on the next read. The old
+    // CmaPeer is RETIRED, not destroyed — a pool thread may still be
+    // inside TryReadV on its raw pointer (those reads target the dead
+    // pid and fail fast); it is freed at transport teardown.
     std::lock_guard<std::mutex> lock(p.cma_mu);
     p.cma_state = 0;
-    p.cma.reset();
+    if (p.cma) p.cma_retired.push_back(std::move(p.cma));
   }
   return kOk;
 }
@@ -827,12 +830,24 @@ void TcpTransport::RecordBulkSample(bool via_tcp, int64_t bytes,
   bool flip_to_cma = bulk_via_tcp_ && cma_bulk_bw_ > 1.25 * tcp_bulk_bw_;
   if (flip_to_tcp || flip_to_cma) {
     bulk_via_tcp_ = flip_to_tcp;
+    ++bulk_crossovers_;
     std::fprintf(stderr,
                  "[dds r%d] bulk reads now routed via %s (CMA %.2f GB/s "
                  "vs TCP %.2f GB/s)\n",
                  rank_, flip_to_tcp ? "TCP" : "CMA", cma_bulk_bw_ / 1e9,
                  tcp_bulk_bw_ / 1e9);
   }
+}
+
+void TcpTransport::RoutingState(double* cma_bw, double* tcp_bw,
+                                int64_t* decisions, int64_t* crossovers,
+                                int* via_tcp) {
+  std::lock_guard<std::mutex> lock(route_mu_);
+  *cma_bw = cma_bulk_bw_;
+  *tcp_bw = tcp_bulk_bw_;
+  *decisions = bulk_decisions_;
+  *crossovers = bulk_crossovers_;
+  *via_tcp = bulk_via_tcp_ ? 1 : 0;
 }
 
 int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
@@ -850,6 +865,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     // part-lists per peer (mirrors the TCP path's connection striping).
     constexpr int64_t kCmaChunk = 4 << 20;
     constexpr int kCmaMaxPar = 8;
+    constexpr int64_t kCmaMinOpsPerPart = 256;
     struct CmaTry {
       const PeerReadV* rq;
       CmaPeer* peer;
@@ -878,9 +894,18 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
       }
       CmaTry t{&rq, peer, total, {}, {}, {}};
       int nparts = 1;
-      if (total > 2 * kCmaChunk)
+      if (total > 2 * kCmaChunk) {
         nparts = static_cast<int>(std::min<int64_t>(
             kCmaMaxPar, (total + kCmaChunk - 1) / kCmaChunk));
+      } else if (rq.n >= 2 * kCmaMinOpsPerPart) {
+        // Scattered batch (many small rows, modest bytes): one
+        // process_vm_readv walks every segment on a single core, so
+        // spread whole ops across parallel part-lists the same way the
+        // TCP path stripes them across connections — the per-segment
+        // kernel cost then rides every core, not one.
+        nparts = static_cast<int>(std::min<int64_t>(
+            kCmaMaxPar, rq.n / kCmaMinOpsPerPart));
+      }
       if (nparts == 1) {
         t.spans.emplace_back(rq.ops, rq.n);
       } else {

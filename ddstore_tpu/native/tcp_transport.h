@@ -108,6 +108,12 @@ class TcpTransport : public Transport {
   // tests asserting the path actually engaged).
   int64_t cma_ops() const { return cma_ops_.load(); }
 
+  // Adaptive bulk-routing state snapshot (observability: exported into
+  // bench extras so routing regressions are diagnosable from the JSON
+  // record alone).
+  void RoutingState(double* cma_bw, double* tcp_bw, int64_t* decisions,
+                    int64_t* crossovers, int* via_tcp);
+
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
   int ReadV(int target, const std::string& name, const ReadOp* ops,
@@ -143,6 +149,12 @@ class TcpTransport : public Transport {
     std::mutex cma_mu;
     int cma_state = 0;
     std::unique_ptr<CmaPeer> cma;
+    // CmaPeers retired by UpdatePeer (elastic recovery). Raw pointers
+    // returned by EnsureCmaPeer may still be mid-TryReadV on pool
+    // threads with no lock held, so a retired peer is parked here —
+    // alive but inert (reads against the dead pid fail fast) — and
+    // freed at transport teardown. Bounded: one entry per recovery.
+    std::vector<std::unique_ptr<CmaPeer>> cma_retired;
   };
 
   // Probe/return the peer's CMA mapping (nullptr = use TCP).
@@ -193,6 +205,9 @@ class TcpTransport : public Transport {
   double cma_bulk_bw_ = 0.0;  // EWMA bytes/s; 0 = no sample yet
   double tcp_bulk_bw_ = 0.0;
   int64_t bulk_decisions_ = 0;
+  int64_t bulk_crossovers_ = 0;  // preference flips (observability: a
+  //                               flapping policy shows up as a count,
+  //                               diagnosable from BENCH json alone)
   bool bulk_via_tcp_ = false;
 
   // Decide the path for one bulk request (and advance the probe counter).

@@ -1,0 +1,91 @@
+"""Shared tiering/index-plane soak harness (BASELINE config-5 scale).
+
+One implementation consumed by BOTH the bench (`bench.py` soak phase)
+and the regression test (`tests/test_tiering.py`) so the two can never
+measure different things: a sparse mmap-backed shard at 10^8-row scale,
+sentinel rows pinning read correctness at far offsets, a Feistel-sampled
+partial epoch of batched gets, and RSS accounting that must track pages
+touched — never the row count (the reference copies every shard into
+RAM at registration, ddstore.hpp:43-49)."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["mmap_soak"]
+
+
+def _vm_rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    raise RuntimeError("no VmRSS in /proc/self/status")
+
+
+def _sentinel(r: int) -> np.ndarray:
+    return np.asarray([r & 0x7FFFFFFF, (r * 31) & 0x7FFFFFFF], np.int32)
+
+
+def mmap_soak(rows: int = 100_000_000, batch: int = 65536,
+              nbatches: int = 64, directory: Optional[str] = None) -> dict:
+    """Run the soak; returns a dict of measurements:
+
+    * ``rows`` / ``rows_sampled`` — shard size and rows actually fetched
+    * ``rows_per_s`` — batched-get throughput of the sampled epoch
+    * ``rss_add_delta_mb`` — RSS growth across ``add_mmap`` (must be
+      ~0: registration must not copy the shard)
+    * ``rss_delta_mb`` — RSS growth across the whole soak (bounded by
+      pages touched, at most the file size — not by row count)
+    * ``sentinels_ok`` — far-offset reads returned the stamped bytes
+    """
+    from .. import DDStore
+    from ..data import DistributedSampler
+
+    d = directory or tempfile.mkdtemp()
+    path = os.path.join(d, "edges.bin")
+    try:
+        with open(path, "wb") as f:
+            f.truncate(rows * 8)  # sparse: 2 x int32 rows, read as zeros
+            stamps = list(range(0, rows, max(1, rows // 63)))[:63] \
+                + [rows - 1]
+            for r in stamps:
+                f.seek(r * 8)
+                f.write(_sentinel(r).tobytes())
+        with DDStore(backend="local") as s:
+            rss0 = _vm_rss_mb()
+            s.add_mmap("edges", path, np.int32, (2,))
+            rss_add = _vm_rss_mb() - rss0
+            assert s.total_rows("edges") == rows
+            got = s.get_batch("edges", stamps)
+            ok = bool((got == np.stack([_sentinel(r)
+                                        for r in stamps])).all())
+            sampler = DistributedSampler(rows, world=1, rank=0, seed=7,
+                                         mode="streamed")
+            t0 = time.perf_counter()
+            n = 0
+            for b in itertools.islice(sampler.batches(batch), nbatches):
+                out = s.get_batch("edges", b)
+                assert out.shape == (len(b), 2)
+                n += len(b)
+            dt = time.perf_counter() - t0
+            return {"rows": rows, "rows_sampled": n,
+                    "rows_per_s": n / dt,
+                    "rss_add_delta_mb": rss_add,
+                    "rss_delta_mb": _vm_rss_mb() - rss0,
+                    "sentinels_ok": ok}
+    finally:
+        if directory is None:
+            shutil.rmtree(d, ignore_errors=True)
+        else:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

@@ -399,3 +399,73 @@ def test_interleaved_sp_losses_match_sequential():
                      schedule="interleaved", n_virtual=2)
     want = _seq_losses(model=_model())
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_4axis_pp_tp_sp_grads_match_sequential(tmp_path):
+    """dp×pp×tp×sp — a v5p-64-class layout — oracle-pinned at 16 virtual
+    devices (VERDICT r4 next #6). Runs in a subprocess: this process is
+    pinned to 8 virtual devices, and XLA's device count is fixed at
+    backend init."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = r'''
+import sys
+sys.path.insert(0, sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from ddstore_tpu.models import transformer
+from ddstore_tpu.models.transformer import lm_from_stages, lm_to_stages
+from ddstore_tpu.parallel import make_mesh
+
+devs = jax.devices()
+assert len(devs) >= 16, len(devs)
+mesh = make_mesh({"dp": 2, "pp": 2, "tp": 2, "sp": 2}, devs[:16])
+# f32: XLA's CPU AllReducePromotion crashes on bf16 collectives (the
+# known virtual-mesh caveat; TPU has native bf16 collectives).
+model = transformer.TransformerLM(vocab=64, dim=32, heads=4, layers=4,
+                                  mesh=mesh, compute_dtype=jnp.float32)
+k1, k2 = jax.random.split(jax.random.key(3))
+b, s = 8, 32
+tokens = jax.random.randint(k1, (b, s), 0, 64)
+targets = jax.random.randint(k2, (b, s), 0, 64)
+positions = jnp.tile(jnp.arange(s), (b, 1))
+params = model.init(jax.random.key(0), tokens, positions)
+outer, stages = lm_to_stages(params, 4, 2)
+stage_fn = transformer._make_stage_fn(model, 2, mesh=mesh)
+
+def run(pp_params):
+    return transformer.pp_gpipe_value_and_grad(
+        model, stage_fn, pp_params, tokens, targets, positions,
+        n_microbatches=2, mesh=mesh, dp_axis="dp")
+
+loss, (g_o, g_st) = jax.jit(run)((outer, stages))
+
+seq_model = model.clone(mesh=None)
+
+def loss_seq(p):
+    return transformer.loss_fn(seq_model.apply(p, tokens, positions),
+                               targets)
+
+l2, g2 = jax.value_and_grad(loss_seq)(params)
+np.testing.assert_allclose(float(loss), float(l2), rtol=1e-5)
+g_joined = lm_from_stages(g_o, g_st, 4, 2)
+for (p1, a), (_, bb) in zip(
+        jax.tree_util.tree_leaves_with_path(g_joined),
+        jax.tree_util.tree_leaves_with_path(g2)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=2e-4,
+                               err_msg=jax.tree_util.keystr(p1))
+print("4AXIS_OK")
+'''
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "").replace(
+        "--xla_force_host_platform_device_count=8", "").strip()
+        + " --xla_force_host_platform_device_count=16").strip()
+    out = subprocess.run([sys.executable, "-c", script, repo], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0 and "4AXIS_OK" in out.stdout, \
+        out.stdout + out.stderr
