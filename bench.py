@@ -733,6 +733,73 @@ def gnn_pipeline_bench(graphs=4096, graphs_per_slot=8, warm_epochs=1,
 # ---------------------------------------------------------------------------
 
 
+def pp_sched_overhead():
+    """Single-chip overhead of the pipeline schedules (VERDICT r4 weak
+    #4): at pp=1 the ring's ppermutes are self-sends and every
+    microbatch runs on one device, so the slowdown vs the plain
+    sequential step is PURE schedule machinery — scan bookkeeping, the
+    per-tick (self-)ppermute latency, the stash rotation, and the
+    per-microbatch head. The multi-chip bubble win can't be measured on
+    one chip; its fixed cost can. Also reports compile times — the
+    interleaved schedules trace V× more stage calls."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddstore_tpu.models import transformer
+    from ddstore_tpu.parallel import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        vocab, dim, heads, layers, b, s = 32768, 512, 8, 8, 8, 512
+        lo, hi = 2, 8
+    else:
+        vocab, dim, heads, layers, b, s = 256, 64, 4, 4, 4, 64
+        lo, hi = 1, 3
+    mesh = make_mesh({"pp": 1}, jax.devices()[:1])
+    model = transformer.TransformerLM(vocab=vocab, dim=dim, heads=heads,
+                                      layers=layers,
+                                      compute_dtype=jnp.bfloat16)
+    k1, k2 = jax.random.split(jax.random.key(1))
+    tokens = jax.random.randint(k1, (b, s), 0, vocab)
+    targets = jax.random.randint(k2, (b, s), 0, vocab)
+    positions = jnp.tile(jnp.arange(s), (b, 1))
+    out = {}
+
+    def steady(step, state):
+        def make_loop(iters):
+            def call():
+                st, loss = state, None
+                for _ in range(iters):
+                    st, loss = step(st, tokens, targets, positions)
+                float(loss)
+            return call
+        return _marginal_time(make_loop, lo, hi)
+
+    state, tx = transformer.create_train_state(jax.random.key(0), model)
+    step = transformer.make_train_step(model, tx, donate=False)
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(state, tokens, targets, positions)[1])
+    out["seq_compile_s"] = time.perf_counter() - t0
+    t_seq = steady(step, state)
+    out["seq_step_ms"] = t_seq * 1e3
+
+    for name, sched, v in (("gpipe", "gpipe", 1),
+                           ("interleaved", "interleaved", 2),
+                           ("interleaved_1f1b", "interleaved_1f1b", 2)):
+        stp, txp = transformer.create_pp_train_state(
+            jax.random.key(0), model, n_stages=1, mesh=mesh, n_virtual=v)
+        pstep = transformer.make_pp_train_step(
+            model, txp, mesh, n_stages=1, n_microbatches=4,
+            schedule=sched, n_virtual=v, donate=False)
+        t0 = time.perf_counter()
+        jax.block_until_ready(pstep(stp, tokens, targets, positions)[1])
+        out[f"{name}_compile_s"] = time.perf_counter() - t0
+        t = steady(pstep, stp)
+        out[f"{name}_step_ms"] = t * 1e3
+        out[f"{name}_overhead_x"] = t / t_seq
+    return out
+
+
 def profile_lm_long(outdir, steps=3):
     """Op-level trace of the long-context train step (VERDICT r4 next
     #2: the ~100 ms gap between the full step and fwd+bwd is only
@@ -847,11 +914,22 @@ def _phase_attnlong():
     return {"attn_long_tf_full_s2": round(atf, 1)}
 
 
+def _phase_ppsched():
+    o = pp_sched_overhead()
+    print(f"# pp schedule overhead (pp=1): " +
+          ", ".join(f"{k}={v:.3g}" for k, v in o.items()),
+          file=sys.stderr)
+    return {f"ppsched_{k}": round(v, 4) for k, v in o.items()}
+
+
+# Order = priority under the run deadline: headline phases first, the
+# schedule-overhead diagnostic last (it is the one to sacrifice).
 _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("soak", _phase_soak),
            ("vae", _phase_vae), ("gnn", _phase_gnn),
            ("numerics", _phase_numerics), ("lm", _phase_lm),
-           ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong))
+           ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong),
+           ("ppsched", _phase_ppsched))
 
 
 def main():

@@ -16,11 +16,12 @@ The restart-time half (bounded timeouts + world-size re-sharding,
   replacement reads the last committed generation from ``<root>/GENERATION``
   — so repeated recoveries in one run compose, and a late replacement can
   never join a stale generation.
-* Endpoints are re-exchanged; survivors re-point only the peers whose
-  endpoint changed (native ``UpdatePeer``: stale connections closed, CMA
-  re-probed against the new pid), the replacement gets the full table via
-  the normal construction path. Barrier sequence numbers are re-synced to
-  the max so the data-plane dissemination barrier stays aligned.
+* Endpoints are re-exchanged; survivors re-point every joiner rank (and
+  any peer whose endpoint changed) via native ``UpdatePeer`` — stale
+  connections closed, CMA re-probed against the new pid — while the
+  replacement gets the full table via the normal construction path.
+  Barrier sequence numbers are re-synced to the max so the data-plane
+  dissemination barrier stays aligned.
 
 Scope: the recovered shard holds the dead rank's LAST CHECKPOINT — rows
 updated after that checkpoint are rolled back on that shard (the same

@@ -76,6 +76,12 @@ def main():
     p.add_argument("--top-p", type=float, default=None,
                    help="nucleus sampling: smallest token set with "
                         "cumulative probability >= p")
+    p.add_argument("--experts", type=int, default=0,
+                   help="swap the MLP for an expert-parallel MoE with "
+                        "this many experts (sharded over any `ep` "
+                        "capacity left after dp*pp*tp)")
+    p.add_argument("--moe-top-k", type=int, default=1,
+                   help="experts per token (1=Switch, 2=GShard)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--steps", type=int, default=None)
     args = p.parse_args()
@@ -109,9 +115,18 @@ def main():
         axes["pp"] = pp
     if tp > 1:
         axes["tp"] = tp
-    if sp > 1:
+    if args.experts and sp > 1:
+        # Leftover capacity serves experts instead of sequence when an
+        # MoE is requested (ep and sp compete for the same devices at
+        # this example's scale; real configs pick explicitly).
+        axes["ep"] = sp
+        sp = 1
+    elif sp > 1:
         axes["sp"] = sp
-    mesh = make_mesh(axes, jax.local_devices()[:dp * pp * tp * sp])
+    n_used = 1
+    for v in axes.values():
+        n_used *= v
+    mesh = make_mesh(axes, jax.local_devices()[:n_used])
 
     group = auto_group()
     store = DDStore(group)
@@ -135,6 +150,7 @@ def main():
     model = transformer.TransformerLM(
         vocab=args.vocab, dim=args.dim, heads=args.dim // 32,
         layers=args.layers, compute_dtype=dtype,
+        n_experts=args.experts, moe_top_k=args.moe_top_k,
         mesh=mesh, remat=args.remat or args.remat_policy is not None,
         remat_policy=args.remat_policy)
     if pp > 1:
@@ -205,7 +221,7 @@ def main():
             outer, stages = params
             params = transformer.lm_from_stages(
                 jax.device_get(outer), jax.device_get(stages),
-                model.layers, pp)
+                model.layers, pp, n_virtual=nv)
         plen = min(32, args.seq)
         prompt = jnp.asarray(windows[:1, :plen])
         out = decode.generate(infer, params, prompt, args.generate,
