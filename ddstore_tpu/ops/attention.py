@@ -37,6 +37,30 @@ except Exception:  # pragma: no cover
 NEG_INF = float("-inf")
 
 
+def _causal_liveness(iq, ik, block_q, block_k, q_offset, kv_offset):
+    """(live, diag) for a causal (q-block, k-block) pair: ``live`` = the
+    block has any unmasked entry; ``diag`` = it straddles the diagonal
+    and needs the iota mask (blocks entirely in the past are mask-free —
+    the mask's compare/select is pure VPU cost). THE single classification
+    shared by the forward and both backward kernels."""
+    q_lo = q_offset + iq * block_q
+    k_lo = kv_offset + ik * block_k
+    live = k_lo <= q_lo + block_q - 1
+    diag = live & (k_lo + block_k - 1 > q_lo)
+    return live, diag
+
+
+def _masked_dispatch(causal, live, diag, update):
+    """Run ``update(masked)`` under the liveness predicates: the diagonal
+    body with masking, interior live blocks without, dead blocks not at
+    all (non-causal: one unmasked body, unconditionally)."""
+    if causal:
+        pl.when(diag)(lambda: update(True))
+        pl.when(live & ~diag)(lambda: update(False))
+    else:
+        update(False)
+
+
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array, *,
                   causal: bool = False, q_offset: int = 0,
                   kv_offset: int = 0, scale: Optional[float] = None
@@ -78,20 +102,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # For causal, a K/V block entirely in the future contributes nothing —
-    # predicate the whole accumulation away (≈halves causal FLOPs).
+    # predicate the whole accumulation away (≈halves causal FLOPs). Blocks
+    # entirely in the PAST need no mask either: the iota/compare/select on
+    # a (block_q, block_k) tile is pure VPU work and the kernel is
+    # VPU-bound, so interior blocks take a mask-free body and only the
+    # O(S/block) diagonal-straddling blocks pay for masking.
     if causal:
-        live = (kv_offset + ik * block_k
-                <= q_offset + iq * block_q + block_q - 1)
+        live, diag = _causal_liveness(iq, ik, block_q, block_k, q_offset,
+                                      kv_offset)
     else:
-        live = True
+        live, diag = True, False
 
-    @pl.when(live)
-    def _():
+    def update(masked):
         q = q_ref[0]  # (block_q, D)
         k = k_ref[0]  # (block_k, D)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
-        if causal:
+        if masked:
             qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kv_offset + ik * block_k + jax.lax.broadcasted_iota(
@@ -101,10 +128,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_prev = m_scr[:, :1]                               # (block_q, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        # Rows with everything masked so far keep m=-inf; guard the exps.
+        # Rows with everything masked so far keep m=-inf; safe_m keeps the
+        # subtraction finite and exp(-inf - 0) = 0 zeroes their p exactly
+        # (no full-block select needed).
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - safe_m)
-        p = jnp.where(jnp.isfinite(m_new), p, 0.0)
         corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * corr + jnp.dot(
@@ -112,6 +140,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    _masked_dispatch(causal, live, diag, update)
 
     @pl.when(ik == nk - 1)
     def _():
@@ -180,10 +210,14 @@ def _fwd_impl(q, k, v, causal, q_offset, kv_offset, scale, block_q, block_k,
             lse_f)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dq_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, dta_ref, dq_ref,
                    dq_acc, *, scale, causal, q_offset, kv_offset, block_q,
                    block_k):
-    """dq for one q block, streaming k/v blocks (recompute-p flash bwd)."""
+    """dq for one q block, streaming k/v blocks (recompute-p flash bwd).
+
+    ``dta`` packs the three per-row residual scalars into one 128-lane
+    tensor (lane 0 = delta = rowsum(do*o), lane 1 = the lse cotangent,
+    lane 2 = lse): one streamed side input instead of two."""
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -191,41 +225,50 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dq_ref,
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = True
     if causal:
-        live = (kv_offset + ik * block_k
-                <= q_offset + iq * block_q + block_q - 1)
+        live, diag = _causal_liveness(iq, ik, block_q, block_k, q_offset,
+                                      kv_offset)
+    else:
+        live, diag = True, False
 
-    @pl.when(live)
-    def _():
+    def update(masked):
         q = q_ref[0]
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kv_offset + ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        lse = lse_ref[0][:, :1]                              # (block_q, 1)
-        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        lse = dta_ref[0][:, 2:3]                             # (block_q, 1)
+        # Fully-masked rows have lse = -inf; exp(s - safe_lse) is then
+        # exp(-inf - big) = 0 for every column — no full-block select.
+        safe_lse = jnp.where(jnp.isfinite(lse), lse, 1e30)
+        p = jnp.exp(s - safe_lse)
         do = do_ref[0]
         dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
-        # dta carries delta (= rowsum(do*o)) in lane 0 and the lse
-        # cotangent in lane 1: ds = p * (dp - delta + dlse).
+        # ds = p * (dp - delta + dlse).
         t = p * (dp - dta_ref[0][:, :1] + dta_ref[0][:, 1:2])
         dq_acc[:] = dq_acc[:] + jnp.dot(
             t.astype(k.dtype), k, preferred_element_type=jnp.float32) * scale
+
+    _masked_dispatch(causal, live, diag, update)
 
     @pl.when(ik == nk - 1)
     def _():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, dta_ref, dk_ref,
                     dv_ref, dk_acc, dv_acc, *, scale, causal, q_offset,
                     kv_offset, block_q, block_k):
-    """dk/dv for one k/v block, streaming q blocks."""
+    """dk/dv for one k/v block, streaming q blocks.
+
+    The q-side streams (q, do, dta) re-fetch every grid step here (their
+    block index rides the innermost loop), so the packed single ``dta``
+    side input (delta/dlse/lse in lanes 0/1/2) halves the f32 side-stream
+    HBM traffic vs separate lse + dta tensors."""
     ik, iq = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -234,24 +277,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = True
     if causal:
-        live = (kv_offset + ik * block_k
-                <= q_offset + iq * block_q + block_q - 1)
+        live, diag = _causal_liveness(iq, ik, block_q, block_k, q_offset,
+                                      kv_offset)
+    else:
+        live, diag = True, False
 
-    @pl.when(live)
-    def _():
+    def update(masked):
         q = q_ref[0]
         k = k_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             qpos = q_offset + iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = kv_offset + ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(kpos <= qpos, s, NEG_INF)
-        lse = lse_ref[0][:, :1]
-        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        lse = dta_ref[0][:, 2:3]
+        safe_lse = jnp.where(jnp.isfinite(lse), lse, 1e30)
+        p = jnp.exp(s - safe_lse)
         do = do_ref[0]
         dv_acc[:] = dv_acc[:] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32)
@@ -261,31 +305,40 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dta_ref, dk_ref,
             t.astype(q.dtype).T, q, preferred_element_type=jnp.float32) \
             * scale
 
+    _masked_dispatch(causal, live, diag, update)
+
     @pl.when(iq == nq - 1)
     def _():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(3, 11)))
 def _flash(q, k, v, causal, q_offset, kv_offset, scale, block_q, block_k,
-           interpret):
+           bwd_blocks, interpret):
     out, lse, _ = _fwd_impl(q, k, v, causal, q_offset, kv_offset, scale,
                             block_q, block_k, interpret)
     return out, lse
 
 
 def _flash_fwd(q, k, v, causal, q_offset, kv_offset, scale, block_q,
-               block_k, interpret):
-    out, lse, lse128 = _fwd_impl(q, k, v, causal, q_offset, kv_offset,
-                                 scale, block_q, block_k, interpret)
-    return (out, lse), (q, k, v, out, lse128)
+               block_k, bwd_blocks, interpret):
+    out, lse, _ = _fwd_impl(q, k, v, causal, q_offset, kv_offset,
+                            scale, block_q, block_k, interpret)
+    # Residual is the THIN (B, H, S) lse — the kernel's 128-lane output
+    # is tile-alignment scaffolding and holding it across fwd→bwd would
+    # cost 128x the activation memory (~1 GiB at the S=8192 LM config).
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, q_offset, kv_offset, scale, block_q, block_k,
-               interpret, res, g):
-    q, k, v, out, lse128 = res
+               bwd_blocks, interpret, res, g):
+    q, k, v, out, lse = res
     do, dlse = g
+    # The backward kernels stream different data patterns than the
+    # forward (dq: k/v innermost; dkv: the whole q side innermost), so
+    # they take their own block shapes.
+    bq_dq, bk_dq, bq_dkv, bk_dkv = bwd_blocks
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bhs = b * h
@@ -293,60 +346,62 @@ def _flash_bwd(causal, q_offset, kv_offset, scale, block_q, block_k,
     kf = k.reshape(bhs, sk, d)
     vf = v.reshape(bhs, sk, d)
     dof = do.reshape(bhs, sq, d)
-    # delta_i = rowsum(do_i * o_i); packed with the lse cotangent into the
-    # two leading lanes of a 128-lane tensor (tile-aligned input).
+    # Per-row residual scalars packed into ONE 128-lane tensor (lane 0:
+    # delta = rowsum(do*o); lane 1: lse cotangent; lane 2: lse) — a
+    # single streamed side input per kernel instead of two.
     delta = jnp.sum(dof.astype(jnp.float32)
                     * out.reshape(bhs, sq, d).astype(jnp.float32), axis=-1)
     dta = jnp.zeros((bhs, sq, 128), jnp.float32)
     dta = dta.at[..., 0].set(delta)
     dta = dta.at[..., 1].set(dlse.reshape(bhs, sq).astype(jnp.float32))
+    dta = dta.at[..., 2].set(lse.reshape(bhs, sq))
 
     common = dict(scale=scale, causal=causal, q_offset=q_offset,
-                  kv_offset=kv_offset, block_q=block_q, block_k=block_k)
+                  kv_offset=kv_offset)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
-        grid=(bhs, sq // block_q, sk // block_k),
+        functools.partial(_bwd_dq_kernel, block_q=bq_dq, block_k=bk_dq,
+                          **common),
+        grid=(bhs, sq // bq_dq, sk // bk_dq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq_dq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk_dq, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk_dq, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bq_dq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq_dq, 128), lambda bh, i, j: (bh, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, bq_dq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bhs, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq_dq, d), jnp.float32)],
         interpret=interpret,
         **_tpu_params(interpret),
-    )(qf, kf, vf, dof, lse128, dta)
+    )(qf, kf, vf, dof, dta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(bhs, sk // block_k, sq // block_q),
+        functools.partial(_bwd_dkv_kernel, block_q=bq_dkv, block_k=bk_dkv,
+                          **common),
+        grid=(bhs, sk // bk_dkv, sq // bq_dkv),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq_dkv, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bq_dkv, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq_dkv, 128), lambda bh, j, i: (bh, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk_dkv, d), lambda bh, j, i: (bh, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bhs, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bhs, sk, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((bk_dkv, d), jnp.float32),
+            pltpu.VMEM((bk_dkv, d), jnp.float32),
         ],
         interpret=interpret,
         **_tpu_params(interpret),
-    )(qf, kf, vf, dof, lse128, dta)
+    )(qf, kf, vf, dof, dta)
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
             dv.reshape(b, h, sk, d))
 
@@ -369,6 +424,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     kv_offset: int = 0, scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    bwd_blocks: Optional[Tuple[int, int, int, int]] = None,
                     interpret: Optional[bool] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Pallas flash attention over (B, H, S, D); returns (out, lse).
@@ -380,12 +436,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     already guarantee static shapes). On non-TPU backends the same
     kernels run in interpreter mode.
 
-    block_q/block_k are upper bounds, fitted per call to the largest
-    divisor of the sequence length that is a multiple of 8. The defaults
-    are length-adaptive, tuned on v5e: 512x2048 below S=8192 (measured
-    ~101 TF/s useful vs ~13 TF/s at 128x128 — grid-step overhead, not
-    FLOPs, dominates small blocks) and 1024x1024 at S>=8192 (measured
-    6% faster fwd+bwd there; 2048-wide q blocks exceed VMEM).
+    block_q/block_k (forward) and ``bwd_blocks`` = (block_q_dq,
+    block_k_dq, block_q_dkv, block_k_dkv) are upper bounds, fitted per
+    call to the largest divisor of the sequence length that is a multiple
+    of 8. The defaults are length-adaptive, tuned on v5e with FULL
+    fwd+dq+dkv gradients: 512x2048 below S=8192 (measured ~101 TF/s
+    useful vs ~13 TF/s at 128x128 — grid-step overhead, not FLOPs,
+    dominates small blocks) and 1024x1024 at S>=8192 (2048-wide q blocks
+    exceed VMEM). The backward defaults follow block_q/block_k unless
+    overridden.
     """
     if not _HAS_PALLAS:  # pragma: no cover
         return mha_reference(q, k, v, causal=causal, q_offset=q_offset,
@@ -406,9 +465,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if not block_q or not block_k:
         raise ValueError(f"seq lens ({sq},{sk}) must be multiples of 8 "
                          f"(TPU tile alignment)")
+    if bwd_blocks is None:
+        bwd_blocks = (block_q, block_k, block_q, block_k)
+    else:
+        if any(bl < 8 for bl in bwd_blocks):
+            raise ValueError(f"bwd_blocks entries must be >= 8 (TPU "
+                             f"sublane tile), got {bwd_blocks}")
+        bq_dq, bk_dq, bq_dkv, bk_dkv = bwd_blocks
+        bwd_blocks = (_fit_block(bq_dq, sq), _fit_block(bk_dq, sk),
+                      _fit_block(bq_dkv, sq), _fit_block(bk_dkv, sk))
+        if not all(bwd_blocks):
+            raise ValueError(f"seq lens ({sq},{sk}) must be multiples of "
+                             f"8 (TPU tile alignment)")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(q, k, v, causal, q_offset, kv_offset, scale, block_q,
-                  block_k, interpret)
+                  block_k, bwd_blocks, interpret)
