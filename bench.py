@@ -175,11 +175,18 @@ def _tcp_worker(rank, world, rdv, outfile, num, dim):
                 if os.environ.get("DDSTORE_CMA_BULK") == "1":
                     # The forced numbers above measured the true CMA
                     # path; now measure what the production default
-                    # (adaptive routing) delivers for the same read.
+                    # (adaptive routing) delivers for the same reads.
                     del os.environ["DDSTORE_CMA_BULK"]
+                    os.environ.pop("DDSTORE_CMA_SCATTER", None)
                     res["auto_stripe_gbps"] = best_bw(
                         lambda: s.get("bench", num, nrows, out=shard_dst),
                         nrows * dim * 8, reps=4)
+                    # reps=8: the scatter router needs one CMA and one
+                    # TCP sample before it can prefer, plus a few
+                    # steady-state reads for the EWMA to mean anything.
+                    res["auto_batch_gbps"] = best_bw(
+                        lambda: s.get_batch("bench", idxs, out=bdst),
+                        idxs.size * dim * 8, reps=8)
                     # Routing observability (VERDICT r4 next #8): the
                     # adaptive state lands in bench extras so a future
                     # routing regression (flapping, a parked-wrong
@@ -265,18 +272,27 @@ def tcp_microbench(world=4, num=65536, dim=64):
         ({"DDSTORE_CONNS_PER_PEER": "1", "DDSTORE_CMA": "0"},
          {"tcp_stripe_gbps": "tcp_stripe_gbps_1conn",
           "tcp_batch_gbps": "tcp_batch_gbps_1conn"}),
-        ({"DDSTORE_CONNS_PER_PEER": "4", "DDSTORE_CMA": "0"}, None),
-        ({"DDSTORE_CONNS_PER_PEER": "4", "DDSTORE_CMA": "1",
-          "DDSTORE_CMA_BULK": "1"},
+        # Production connection default (core-aware): forcing 4 striped
+        # connections on a 1-core box measures an anti-configuration the
+        # transport itself would never pick.
+        ({"DDSTORE_CMA": "0"}, None),
+        ({"DDSTORE_CMA": "1",
+          "DDSTORE_CMA_BULK": "1", "DDSTORE_CMA_SCATTER": "1"},
          {"tcp_get_p50_us": "cma_get_p50_us",
           "tcp_stripe_gbps": "cma_stripe_gbps",
           "tcp_batch_gbps": "cma_batch_gbps",
           "auto_stripe_gbps": "cma_auto_stripe_gbps",
+          "auto_batch_gbps": "auto_batch_gbps",
           "route_cma_bulk_gbps": "route_cma_bulk_gbps",
           "route_tcp_bulk_gbps": "route_tcp_bulk_gbps",
           "route_bulk_decisions": "route_bulk_decisions",
           "route_bulk_crossovers": "route_bulk_crossovers",
-          "route_bulk_via_tcp": "route_bulk_via_tcp"}),
+          "route_bulk_via_tcp": "route_bulk_via_tcp",
+          "route_cma_scatter_gbps": "route_cma_scatter_gbps",
+          "route_tcp_scatter_gbps": "route_tcp_scatter_gbps",
+          "route_scatter_decisions": "route_scatter_decisions",
+          "route_scatter_crossovers": "route_scatter_crossovers",
+          "route_scatter_via_tcp": "route_scatter_via_tcp"}),
     )
     for env, keys in passes:
         rdv = tempfile.mkdtemp()

@@ -50,7 +50,7 @@ def _load() -> ctypes.CDLL:
     lib.dds_barrier_seq.argtypes = [ctypes.c_void_p]
     lib.dds_routing_state.restype = ctypes.c_int
     lib.dds_routing_state.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double),
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double), _i64p, _i64p,
         ctypes.POINTER(ctypes.c_int)]
     lib.dds_set_barrier_seq.restype = ctypes.c_int
@@ -183,24 +183,28 @@ class NativeStore:
             self._h, target, host.encode(), port), f"update_peer({target})")
 
     def routing_state(self) -> dict:
-        """Adaptive bulk-routing snapshot: per-path EWMA bandwidths,
-        decision/probe counts, crossovers, current preference —
-        exported into bench extras so routing regressions are
-        diagnosable from the BENCH json alone."""
-        cma = ctypes.c_double()
-        tcp = ctypes.c_double()
-        dec = ctypes.c_int64()
-        cro = ctypes.c_int64()
-        via = ctypes.c_int()
-        _check(self._lib.dds_routing_state(
-            self._h, ctypes.byref(cma), ctypes.byref(tcp),
-            ctypes.byref(dec), ctypes.byref(cro), ctypes.byref(via)),
-            "routing_state")
-        return {"cma_bulk_gbps": cma.value / 1e9,
-                "tcp_bulk_gbps": tcp.value / 1e9,
-                "bulk_decisions": dec.value,
-                "bulk_crossovers": cro.value,
-                "bulk_via_tcp": bool(via.value)}
+        """Adaptive routing snapshot for both traffic classes (bulk =
+        single >=8 MiB reads; scatter = many-small-op batches): per-path
+        EWMA bandwidths, decision/probe counts, crossovers, current
+        preference — exported into bench extras so routing regressions
+        are diagnosable from the BENCH json alone."""
+        out = {}
+        for cls, label in ((0, "bulk"), (1, "scatter")):
+            cma = ctypes.c_double()
+            tcp = ctypes.c_double()
+            dec = ctypes.c_int64()
+            cro = ctypes.c_int64()
+            via = ctypes.c_int()
+            _check(self._lib.dds_routing_state(
+                self._h, cls, ctypes.byref(cma), ctypes.byref(tcp),
+                ctypes.byref(dec), ctypes.byref(cro), ctypes.byref(via)),
+                "routing_state")
+            out.update({f"cma_{label}_gbps": cma.value / 1e9,
+                        f"tcp_{label}_gbps": tcp.value / 1e9,
+                        f"{label}_decisions": dec.value,
+                        f"{label}_crossovers": cro.value,
+                        f"{label}_via_tcp": bool(via.value)})
+        return out
 
     @property
     def barrier_seq(self) -> int:

@@ -67,11 +67,12 @@ int dds_update_peer(dds_handle* h, int target, const char* host_csv,
   return h->tcp->UpdatePeer(target, host_csv, port);
 }
 
-int dds_routing_state(dds_handle* h, double* cma_bw, double* tcp_bw,
-                      int64_t* decisions, int64_t* crossovers,
-                      int* via_tcp) {
+int dds_routing_state(dds_handle* h, int cls, double* cma_bw,
+                      double* tcp_bw, int64_t* decisions,
+                      int64_t* crossovers, int* via_tcp) {
   if (!h || !h->tcp) return dds::kErrInvalidArg;
-  h->tcp->RoutingState(cma_bw, tcp_bw, decisions, crossovers, via_tcp);
+  h->tcp->RoutingState(cls, cma_bw, tcp_bw, decisions, crossovers,
+                       via_tcp);
   return dds::kOk;
 }
 

@@ -268,6 +268,7 @@ TcpTransport::TcpTransport(int rank, int world, int port)
   // Striping only pays when there are cores to run the extra streams and
   // serving threads (TPU-VM hosts have ~100; CI boxes may have 1).
   unsigned hw = std::thread::hardware_concurrency();
+  hw_cores_ = hw ? hw : 1;
   long nconn = EnvLong("DDSTORE_CONNS_PER_PEER", hw >= 8 ? 4 : 1);
   if (nconn > 64) nconn = 64;
   peers_.resize(world_);
@@ -793,61 +794,68 @@ CmaPeer* TcpTransport::EnsureCmaPeer(Peer& p, int target) {
 
 // Bulk threshold for adaptive routing: matches the point where CMA part
 // striping engages (2 x kCmaChunk). Below it the per-request cost is
-// latency-dominated and CMA wins wherever it works at all.
+// latency-dominated for single reads; MANY-op batches below it form the
+// scatter class, routed by its own estimate.
 constexpr int64_t kBulkBytes = 8 << 20;
+// A same-host request with at least this many ops (and < kBulkBytes
+// total) is scatter-class: per-op overhead dominates, and which path
+// carries that overhead cheaper is a property of the kernel/NIC, not of
+// the bulk bandwidth — measured separately.
+constexpr int64_t kScatterMinOps = 64;
 
-bool TcpTransport::RouteBulkViaTcp() {
-  // DDSTORE_CMA_BULK pins the choice ("1" = always CMA, "0" = always
-  // TCP); read per call so benches/tests can flip it at runtime.
-  if (const char* env = ::getenv("DDSTORE_CMA_BULK")) {
+bool TcpTransport::RouteViaTcp(RouteClass& rc) {
+  // The pin env ("1" = always CMA, "0" = always TCP) is read per call so
+  // benches/tests can flip it at runtime.
+  if (const char* env = ::getenv(rc.pin_env)) {
     if (env[0] == '1') return false;
     if (env[0] == '0') return true;
   }
   std::lock_guard<std::mutex> lock(route_mu_);
-  const int64_t d = bulk_decisions_++;
-  // Sample collection: the first bulk read measures CMA, the second
-  // measures TCP, so the comparison exists from the third on.
-  if (cma_bulk_bw_ == 0.0) return false;
-  if (tcp_bulk_bw_ == 0.0) return true;
-  // Steady state: every 16th bulk read probes the non-preferred path so
-  // a stale estimate can recover (e.g. TCP ahead only because its first
+  const int64_t d = rc.decisions++;
+  // Sample collection: the first read of the class measures CMA, the
+  // second measures TCP, so the comparison exists from the third on.
+  if (rc.cma_bw == 0.0) return false;
+  if (rc.tcp_bw == 0.0) return true;
+  // Steady state: every 16th read probes the non-preferred path so a
+  // stale estimate can recover (e.g. TCP ahead only because its first
   // sample paid connection setup).
   const bool probe = (d & 15) == 15;
-  return probe ? !bulk_via_tcp_ : bulk_via_tcp_;
+  return probe ? !rc.via_tcp : rc.via_tcp;
 }
 
-void TcpTransport::RecordBulkSample(bool via_tcp, int64_t bytes,
-                                    double secs) {
-  if (bytes < kBulkBytes || secs <= 0.0) return;
+void TcpTransport::RecordRouteSample(RouteClass& rc, bool via_tcp,
+                                     int64_t bytes, double secs) {
+  if (bytes <= 0 || secs <= 0.0) return;
   const double bw = static_cast<double>(bytes) / secs;
   std::lock_guard<std::mutex> lock(route_mu_);
-  double& est = via_tcp ? tcp_bulk_bw_ : cma_bulk_bw_;
+  double& est = via_tcp ? rc.tcp_bw : rc.cma_bw;
   est = est == 0.0 ? bw : 0.5 * est + 0.5 * bw;
-  if (cma_bulk_bw_ == 0.0 || tcp_bulk_bw_ == 0.0) return;
+  if (rc.cma_bw == 0.0 || rc.tcp_bw == 0.0) return;
   // 1.25x hysteresis: flapping between near-equal paths costs probes and
   // log noise for no bandwidth.
-  bool flip_to_tcp = !bulk_via_tcp_ && tcp_bulk_bw_ > 1.25 * cma_bulk_bw_;
-  bool flip_to_cma = bulk_via_tcp_ && cma_bulk_bw_ > 1.25 * tcp_bulk_bw_;
+  bool flip_to_tcp = !rc.via_tcp && rc.tcp_bw > 1.25 * rc.cma_bw;
+  bool flip_to_cma = rc.via_tcp && rc.cma_bw > 1.25 * rc.tcp_bw;
   if (flip_to_tcp || flip_to_cma) {
-    bulk_via_tcp_ = flip_to_tcp;
-    ++bulk_crossovers_;
+    rc.via_tcp = flip_to_tcp;
+    ++rc.crossovers;
     std::fprintf(stderr,
-                 "[dds r%d] bulk reads now routed via %s (CMA %.2f GB/s "
+                 "[dds r%d] %s reads now routed via %s (CMA %.2f GB/s "
                  "vs TCP %.2f GB/s)\n",
-                 rank_, flip_to_tcp ? "TCP" : "CMA", cma_bulk_bw_ / 1e9,
-                 tcp_bulk_bw_ / 1e9);
+                 rank_, rc.name, flip_to_tcp ? "TCP" : "CMA",
+                 rc.cma_bw / 1e9, rc.tcp_bw / 1e9);
   }
 }
 
-void TcpTransport::RoutingState(double* cma_bw, double* tcp_bw,
+void TcpTransport::RoutingState(int cls, double* cma_bw, double* tcp_bw,
                                 int64_t* decisions, int64_t* crossovers,
                                 int* via_tcp) {
   std::lock_guard<std::mutex> lock(route_mu_);
-  *cma_bw = cma_bulk_bw_;
-  *tcp_bw = tcp_bulk_bw_;
-  *decisions = bulk_decisions_;
-  *crossovers = bulk_crossovers_;
-  *via_tcp = bulk_via_tcp_ ? 1 : 0;
+  const RouteClass& rc = cls == 1 ? scatter_route_ : bulk_route_;
+  *cma_bw = rc.cma_bw;
+  *tcp_bw = rc.tcp_bw;
+  *decisions = rc.decisions;
+  *crossovers = rc.crossovers;
+  *via_tcp = rc.via_tcp ? 1 : 0;
 }
 
 int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
@@ -883,10 +891,18 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
       CmaPeer* peer = nullptr;
       int64_t total = 0;
       for (int64_t i = 0; i < rq.n; ++i) total += rq.ops[i].nbytes;
-      // Bulk requests go to whichever path measures faster (see
-      // RouteBulkViaTcp); small ones always prefer CMA.
-      if (rq.target >= 0 && rq.target < world_ && rq.target != rank_ &&
-          rq.n > 0 && (total < kBulkBytes || !RouteBulkViaTcp()))
+      // Bulk and scattered requests each go to whichever path measures
+      // faster for THEIR class (see RouteViaTcp); small few-op reads
+      // always prefer CMA (it wins on latency wherever it works).
+      const bool scatter_class = total < kBulkBytes &&
+                                 rq.n >= kScatterMinOps;
+      bool want_cma = true;
+      if (total >= kBulkBytes)
+        want_cma = !RouteBulkViaTcp();
+      else if (scatter_class)
+        want_cma = !RouteScatterViaTcp();
+      if (want_cma && rq.target >= 0 && rq.target < world_ &&
+          rq.target != rank_ && rq.n > 0)
         peer = EnsureCmaPeer(*peers_[rq.target], rq.target);
       if (!peer) {
         rest.push_back(rq);
@@ -906,6 +922,11 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
         nparts = static_cast<int>(std::min<int64_t>(
             kCmaMaxPar, rq.n / kCmaMinOpsPerPart));
       }
+      // The kernel copy is CPU-bound: more part-lists than cores is pure
+      // dispatch overhead (measured 0.30 vs 0.43 GB/s scattered on a
+      // 1-core box).
+      nparts = static_cast<int>(std::min<unsigned>(
+          static_cast<unsigned>(nparts), hw_cores_));
       if (nparts == 1) {
         t.spans.emplace_back(rq.ops, rq.n);
       } else {
@@ -946,7 +967,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
             inline_try->spans[inline_pi].second);
       group.Wait();
       int64_t cma_ok_bytes = 0;
-      bool cma_all_ok = true, cma_any_bulk = false;
+      bool cma_all_ok = true, cma_any_bulk = false, cma_any_scatter = false;
       for (CmaTry& t : tries) {
         bool ok = true;
         for (int r : t.results) ok = ok && r == kOk;
@@ -954,6 +975,14 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
           cma_ops_.fetch_add(t.rq->n, std::memory_order_relaxed);
           cma_ok_bytes += t.bytes;
           cma_any_bulk = cma_any_bulk || t.bytes >= kBulkBytes;
+          // Scatter-class = a SINGLE request with >= kScatterMinOps ops
+          // (same per-request rule the routing decision and the TCP-side
+          // sample use) — an aggregate op count over many few-op
+          // requests would feed latency-dominated multi-peer batches
+          // into the scatter estimate one-sidedly.
+          cma_any_scatter = cma_any_scatter ||
+                            (t.bytes < kBulkBytes &&
+                             t.rq->n >= kScatterMinOps);
         } else {
           // All-or-nothing per peer: TCP redoes the whole request (the
           // parts that DID land wrote the same bytes TCP will write).
@@ -961,17 +990,20 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
           cma_all_ok = false;
         }
       }
-      // Sample hygiene: the estimate drives bulk routing, so feed it
-      // only clean bulk measurements — at least one single request over
-      // the threshold (an 8 MiB *aggregate* of scattered rows measures
-      // per-op overhead, not bandwidth) and no failed tries (their time
-      // stays in the window but their bytes don't).
-      if (cma_all_ok && cma_any_bulk)
-        RecordBulkSample(
-            /*via_tcp=*/false, cma_ok_bytes,
-            std::chrono::duration<double>(
-                std::chrono::steady_clock::now() - cma_t0)
-                .count());
+      // Sample hygiene: each estimate drives its class's routing, so
+      // feed it only clean measurements of that class — bulk needs at
+      // least one single request over the threshold (an 8 MiB
+      // *aggregate* of scattered rows measures per-op overhead, not
+      // bandwidth); scatter needs NO bulk request in the batch (the
+      // bulk copy would dominate the wall time); and neither takes
+      // failed tries (their time stays in the window but their bytes
+      // don't).
+      if (cma_all_ok && (cma_any_bulk || cma_any_scatter)) {
+        const double secs = std::chrono::duration<double>(
+            std::chrono::steady_clock::now() - cma_t0).count();
+        RecordRouteSample(cma_any_bulk ? bulk_route_ : scatter_route_,
+                          /*via_tcp=*/false, cma_ok_bytes, secs);
+      }
     }
     if (rest.empty()) return kOk;
     reqs = rest.data();
@@ -995,6 +1027,11 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   // tcp_bulk_bw_ down and mask a genuinely faster same-host socket
   // path, or inflate it when the DCN leaves parallelize).
   bool tcp_bulk_routable = false;
+  // Same hygiene for the scatter class: a TCP scatter sample counts only
+  // when every leaf targets a CMA-capable peer AND no bulk request rides
+  // in the batch (its copy time would drown the per-op signal).
+  bool tcp_scatter_routable = false;
+  bool any_bulk_req = false;
   bool all_cma = true;
   for (int64_t ri = 0; ri < nreqs; ++ri) {
     const PeerReadV& rq = reqs[ri];
@@ -1017,6 +1054,8 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
       std::lock_guard<std::mutex> lock(p.cma_mu);
       const bool cma_ok = p.cma_state == 1;
       if (total >= kBulkBytes) tcp_bulk_routable |= cma_ok;
+      else if (rq.n >= kScatterMinOps) tcp_scatter_routable |= cma_ok;
+      any_bulk_req = any_bulk_req || total >= kBulkBytes;
       all_cma = all_cma && cma_ok;
     }
     if (nconn <= 1 ||
@@ -1052,12 +1091,16 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   group.Wait();
   for (int rc : rcs)
     if (rc != kOk) return rc;
-  if (tcp_bulk_routable && all_cma) {
+  const bool bulk_sample = tcp_bulk_routable && all_cma;
+  const bool scatter_sample =
+      tcp_scatter_routable && all_cma && !any_bulk_req;
+  if (bulk_sample || scatter_sample) {
     int64_t tcp_bytes = 0;
     for (const Leaf& lf : leaves)
       for (const ReadOp& op : lf.ops) tcp_bytes += op.nbytes;
-    RecordBulkSample(
-        /*via_tcp=*/true, tcp_bytes,
+    RecordRouteSample(
+        bulk_sample ? bulk_route_ : scatter_route_, /*via_tcp=*/true,
+        tcp_bytes,
         std::chrono::duration<double>(std::chrono::steady_clock::now() -
                                       tcp_t0)
             .count());

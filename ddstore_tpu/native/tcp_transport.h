@@ -108,11 +108,11 @@ class TcpTransport : public Transport {
   // tests asserting the path actually engaged).
   int64_t cma_ops() const { return cma_ops_.load(); }
 
-  // Adaptive bulk-routing state snapshot (observability: exported into
-  // bench extras so routing regressions are diagnosable from the JSON
-  // record alone).
-  void RoutingState(double* cma_bw, double* tcp_bw, int64_t* decisions,
-                    int64_t* crossovers, int* via_tcp);
+  // Adaptive routing state snapshot for one traffic class (0 = bulk,
+  // 1 = scatter) — observability: exported into bench extras so routing
+  // regressions are diagnosable from the JSON record alone.
+  void RoutingState(int cls, double* cma_bw, double* tcp_bw,
+                    int64_t* decisions, int64_t* crossovers, int* via_tcp);
 
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
@@ -202,19 +202,38 @@ class TcpTransport : public Transport {
   // transport, not per peer: the decision only matters on same-host
   // peers, which all share one kernel. Guarded by route_mu_.
   std::mutex route_mu_;
-  double cma_bulk_bw_ = 0.0;  // EWMA bytes/s; 0 = no sample yet
-  double tcp_bulk_bw_ = 0.0;
-  int64_t bulk_decisions_ = 0;
-  int64_t bulk_crossovers_ = 0;  // preference flips (observability: a
-  //                               flapping policy shows up as a count,
-  //                               diagnosable from BENCH json alone)
-  bool bulk_via_tcp_ = false;
+  // One adaptive preference per traffic class: "bulk" (>= kBulkBytes in
+  // one request — bandwidth-dominated) and "scatter" (many small ops,
+  // modest bytes — per-op-overhead-dominated; a DistributedSampler
+  // permutation batch). The classes bottleneck differently (one kernel
+  // copy vs per-iovec walk), so one class's winner says nothing about
+  // the other's.
+  struct RouteClass {
+    const char* name;     // log/observability label
+    const char* pin_env;  // env var pinning the choice
+    double cma_bw = 0.0;  // EWMA bytes/s; 0 = no sample yet
+    double tcp_bw = 0.0;
+    int64_t decisions = 0;
+    int64_t crossovers = 0;  // preference flips (observability: a
+    //                          flapping policy shows up as a count,
+    //                          diagnosable from BENCH json alone)
+    bool via_tcp = false;
+  };
+  RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK"};
+  RouteClass scatter_route_{"scattered", "DDSTORE_CMA_SCATTER"};
+  unsigned hw_cores_ = 1;  // CMA striping is CPU-bound; never deal more
+  //                          part-lists than cores (a 1-core box pays
+  //                          pure dispatch overhead for each extra part)
 
-  // Decide the path for one bulk request (and advance the probe counter).
-  bool RouteBulkViaTcp();
-  // Fold a measured (bytes, seconds) bulk sample into one path's EWMA and
+  // Decide the path for one request of the class (advances the probe
+  // counter).
+  bool RouteViaTcp(RouteClass& rc);
+  bool RouteBulkViaTcp() { return RouteViaTcp(bulk_route_); }
+  bool RouteScatterViaTcp() { return RouteViaTcp(scatter_route_); }
+  // Fold a measured (bytes, seconds) sample into one path's EWMA and
   // re-evaluate the preference, logging any crossover.
-  void RecordBulkSample(bool via_tcp, int64_t bytes, double secs);
+  void RecordRouteSample(RouteClass& rc, bool via_tcp, int64_t bytes,
+                         double secs);
 
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,

@@ -275,3 +275,66 @@ def test_bulk_routing_policy_stable(tmp_path):
     assert st["bulk_decisions"] >= 48, st
     assert st["cma_bulk_gbps"] > 0 and st["tcp_bulk_gbps"] > 0, st
     assert st["bulk_crossovers"] <= 2, st
+
+
+def _worker_scatter_routing(rank, world, tmp, q, pin_env):
+    try:
+        os.environ["DDSTORE_CMA"] = "1"
+        os.environ.pop("DDSTORE_CMA_BULK", None)
+        if pin_env is None:
+            os.environ.pop("DDSTORE_CMA_SCATTER", None)
+        else:
+            os.environ["DDSTORE_CMA_SCATTER"] = pin_env
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            rows, dim = 8192, 64  # 512-byte rows: scatter-class batches
+            s.add("scat", np.full((rows, dim), rank + 1, np.float64))
+            s.barrier()
+            trace = []
+            state = {}
+            if rank == 0:
+                rng = np.random.default_rng(0)
+                for _ in range(20):
+                    idxs = rng.integers(rows, 2 * rows, size=512)
+                    before = s.cma_ops
+                    got = s.get_batch("scat", idxs)
+                    assert (got == 2.0).all()
+                    trace.append(s.cma_ops > before)
+                state = s._native.routing_state()
+            s.barrier()
+        q.put((rank, None, (trace, state)))
+    except BaseException:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc(), ([], {})))
+
+
+@pytest.mark.skipif(not _cma_possible(),
+                    reason="yama ptrace_scope >= 2 forbids CMA")
+def test_scatter_routing_forced(tmp_path):
+    """DDSTORE_CMA_SCATTER pins the scatter class: 0 -> every scattered
+    batch rides TCP; 1 -> every one rides CMA (bulk routing unaffected —
+    these batches are far below the bulk threshold)."""
+    info = _spawn(2, _worker_scatter_routing, str(tmp_path), ("0",))
+    assert info[0][0] == [False] * 20, info[0][0]
+    info = _spawn(2, _worker_scatter_routing, str(tmp_path), ("1",))
+    assert info[0][0] == [True] * 20, info[0][0]
+
+
+@pytest.mark.skipif(not _cma_possible(),
+                    reason="yama ptrace_scope >= 2 forbids CMA")
+def test_scatter_routing_adaptive_stable(tmp_path):
+    """Adaptive scatter routing: first batch samples CMA, second samples
+    TCP, then the measured-faster path serves the rest without flapping
+    (same EWMA/probe/hysteresis policy as the bulk class, separate
+    estimates)."""
+    info = _spawn(2, _worker_scatter_routing, str(tmp_path), (None,))
+    trace, st = info[0]
+    assert trace[0] is True, trace    # sample CMA
+    assert trace[1] is False, trace   # sample TCP
+    assert st["scatter_decisions"] >= 20, st
+    assert st["cma_scatter_gbps"] > 0 and st["tcp_scatter_gbps"] > 0, st
+    assert st["scatter_crossovers"] <= 2, st
+    # The bulk class never saw a bulk-sized read: untouched.
+    assert st["bulk_decisions"] == 0, st
