@@ -642,23 +642,21 @@ def lm_long_bench():
 
 def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
     import jax
-    import numpy as np
 
     from ddstore_tpu import DDStore, SingleGroup
     from ddstore_tpu.data import (DeviceLoader, DistributedSampler,
-                                  ShardedDataset)
+                                  ShardedDataset, synthetic_mnist)
     from ddstore_tpu.models import vae
     from ddstore_tpu.parallel import make_mesh
 
     n_dev = len(jax.local_devices())
     mesh = make_mesh({"dp": n_dev}, jax.local_devices())
 
-    g = np.random.default_rng(0)
-    centers = g.random((10, 784), dtype=np.float32)
-    labels = g.integers(0, 10, size=samples).astype(np.int32)
-    data = (centers[labels] * 0.8 +
-            0.2 * g.random((samples, 784), dtype=np.float32)).astype(
-                np.float32)
+    # uint8 pixels, like the real idx files: the store/loader move 4x
+    # fewer bytes and the step dequantizes on device (ToTensor numerics).
+    # Same generator as the example — bench and example train on
+    # identical data.
+    data, _labels = synthetic_mnist(samples, seed=0)
 
     with DDStore(SingleGroup(), backend="local") as store:
         # Labels aren't consumed by the VAE objective; registering data only

@@ -3,8 +3,8 @@
 from .dataset import DistributedSampler, ShardedDataset, nsplit
 from .permute import FeistelPermutation
 from .formats import (find_mnist, load_mnist, load_qm9_dir,
-                      molecule_to_graph, read_idx, read_xyz, write_idx,
-                      write_xyz)
+                      molecule_to_graph, read_idx, read_xyz,
+                      synthetic_mnist, write_idx, write_xyz)
 from .graphs import (GraphBatch, GraphSample, GraphShardedDataset,
                      pack_graph_batch, synthetic_graphs)
 from .loader import DeviceLoader
@@ -17,4 +17,5 @@ __all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader", "nsplit",
            "segment_ids_from_lengths", "GraphBatch", "GraphSample",
            "GraphShardedDataset", "pack_graph_batch", "synthetic_graphs",
            "read_idx", "write_idx", "find_mnist", "load_mnist",
+           "synthetic_mnist",
            "read_xyz", "write_xyz", "molecule_to_graph", "load_qm9_dir"]

@@ -85,11 +85,16 @@ def find_mnist(data_dir: str, split: str = "train"
     return None
 
 
-def load_mnist(data_dir: str, split: str = "train"
+def load_mnist(data_dir: str, split: str = "train", normalize: bool = True
                ) -> Tuple[np.ndarray, np.ndarray]:
-    """(images (N, 784) float32 in [0,1], labels (N,) int32) from the
-    standard idx files (the same normalization torchvision's ToTensor
-    applies in the reference's pipeline, vae-ddp.py:204-209)."""
+    """(images (N, 784), labels (N,) int32) from the standard idx files.
+
+    ``normalize=True`` gives float32 in [0,1] (the normalization
+    torchvision's ToTensor applies in the reference's pipeline,
+    vae-ddp.py:204-209). ``normalize=False`` keeps the raw uint8 pixels
+    — the TPU-first hot path: the store holds and the loader stages 4x
+    fewer bytes, and the model dequantizes on device with identical
+    numerics (uint8/255 is exactly what ToTensor computes)."""
     found = find_mnist(data_dir, split)
     if found is None:
         raise FileNotFoundError(
@@ -100,8 +105,24 @@ def load_mnist(data_dir: str, split: str = "train"
     if images.ndim != 3 or labels.ndim != 1 or len(images) != len(labels):
         raise ValueError(f"MNIST shape mismatch: {images.shape} vs "
                          f"{labels.shape}")
-    flat = images.reshape(len(images), -1).astype(np.float32) / 255.0
+    flat = images.reshape(len(images), -1)
+    if normalize:
+        flat = flat.astype(np.float32) / 255.0
     return flat, labels.astype(np.int32)
+
+
+def synthetic_mnist(n: int, seed: int = 0
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic MNIST-shaped data for offline environments: blurry
+    class-conditioned blobs as uint8 pixels (the real idx files' dtype),
+    same on every rank (like a shared download). One generator shared by
+    the example and the bench so both always train on identical data;
+    stored raw, dequantized on device (see models/vae._dequantize)."""
+    g = np.random.default_rng(seed)
+    labels = g.integers(0, 10, size=n).astype(np.int32)
+    centers = g.random((10, 784), dtype=np.float32)
+    x = centers[labels] * 0.8 + 0.2 * g.random((n, 784), dtype=np.float32)
+    return np.round(x * 255.0).astype(np.uint8), labels
 
 
 # ---------------------------------------------------------------------------

@@ -210,6 +210,12 @@ class DDStore:
                                    f"dtype/sample shape: {sorted(shapes)}")
         all_nrows = [m[0] for m in metas]
         self._native.add(name, arr, all_nrows, copy=copy)
+        # A borrowed buffer the caller can't write (e.g. a frombuffer
+        # view over an immutable bytes object) must refuse update() with
+        # a DDStoreError, not let the native memcpy SIGSEGV on the
+        # unwritable pages.
+        if not copy and not arr.flags.writeable:
+            readonly = True
         self._meta[name] = _VarMeta(arr.dtype, sample_shape, disp, all_nrows,
                                     pinned=None if copy else arr,
                                     readonly=readonly)

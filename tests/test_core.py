@@ -125,6 +125,20 @@ class TestSingleProcessStore:
             with pytest.raises(DDStoreError):
                 s.update("x", np.zeros((3, 2), np.float32), row_offset=2)
 
+    def test_update_refuses_unwritable_borrowed_buffer(self):
+        # copy=False borrows the caller's pages; if those pages aren't
+        # writable (frombuffer over immutable bytes — what read_idx
+        # yields), update() must raise DDStoreError instead of letting
+        # the native memcpy SIGSEGV on them. Reads still work.
+        raw = bytes(range(16)) * 4
+        arr = np.frombuffer(raw, np.uint8).reshape(8, 8)
+        assert not arr.flags.writeable
+        with make_store() as s:
+            s.add("x", arr, copy=False)
+            np.testing.assert_array_equal(s.get("x", 0, 8), arr)
+            with pytest.raises(DDStoreError):
+                s.update("x", np.zeros((1, 8), np.uint8))
+
     def test_free(self):
         with make_store() as s:
             s.add("x", np.zeros((2, 2), np.float32))

@@ -43,6 +43,38 @@ def test_dp_step_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+def test_uint8_batch_matches_normalized_float():
+    # The device-side dequantize path (uint8 staged raw, /255 on device)
+    # must be numerically identical to feeding float32 pixels/255 — the
+    # uint8 path is what the example/bench stage (4x fewer bytes over
+    # the host->device link, ToTensor numerics on device).
+    _, state_a, _ = vae.create_train_state(jax.random.key(0))
+    model, state_b, tx = vae.create_train_state(jax.random.key(0))
+    step = vae.make_train_step(model, tx, donate=False)
+
+    raw = np.random.default_rng(0).integers(0, 256, (16, 784)).astype(
+        np.uint8)
+    key = jax.random.key(7)
+    new_a, loss_a = step(state_a, jnp.asarray(raw), key)
+    new_b, loss_b = step(state_b, jnp.asarray(raw, jnp.float32) / 255.0,
+                         key)
+    # Not bitwise: XLA fuses the on-device /255 into the encoder's bf16
+    # cast differently than the pre-divided program, and Adam's
+    # m/(sqrt(v)+eps) amplifies that where |grad|~eps. Tolerances two
+    # orders below the 1e-3 lr scale.
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(new_a.params),
+                    jax.tree_util.tree_leaves(new_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # eval step takes the same fast path
+    ev = vae.make_eval_step(model)
+    np.testing.assert_allclose(
+        float(ev(new_a.params, jnp.asarray(raw), key)),
+        float(ev(new_b.params, jnp.asarray(raw, jnp.float32) / 255.0, key)),
+        rtol=1e-6)
+
+
 def test_store_fed_training_loss_decreases():
     mesh = make_mesh({"dp": 8})
     g = np.random.default_rng(0)
