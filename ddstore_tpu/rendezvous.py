@@ -133,10 +133,33 @@ class FileGroup(ProcessGroup):
     the worst case for a botched launch is a timeout, never wrong peers.
     One directory per concurrent job; files are pickles, so the directory
     must not be writable by untrusted users (created 0700).
+
+    Directory REUSE across launches (the auto_group default dir, or any
+    fixed DDSTORE_RDV_DIR) adds one more race: a non-zero rank of launch
+    N+1 can read launch N's still-present marker and find launch N's
+    files — a complete-looking hello set, roster, and allgather payloads
+    for a dead generation — before rank 0 of launch N+1 wipes the
+    directory. File existence is therefore never proof of membership:
+    each rank's hello carries a fresh per-process instance nonce, and a
+    rank only joins once a roster written by rank 0 names that nonce. A
+    dead generation's roster cannot name a fresh process, so ranks that
+    raced ahead simply wait, converging to rank 0's fresh marker when it
+    lands. After the join, a marker change observed mid-collective means
+    a NEW world launched in this directory — the collective raises
+    immediately (this process is the stale one) instead of burning the
+    full timeout.
+
+    One identity gap remains without operator help: a straggler rank
+    from a previous launch that never joined (still in its hello loop)
+    is a live process writing fresh nonces, indistinguishable from a
+    slow rank of the current launch — it can win a rank slot. Setting a
+    per-launch ``DDSTORE_RDV_ID`` (or ``launch_id``) closes it: rank 0
+    rosters only hellos carrying its own id.
     """
 
     def __init__(self, root: str, rank: int, size: int,
-                 timeout: float = 120.0):
+                 timeout: float = 120.0,
+                 launch_id: Optional[str] = None):
         self.root = root
         self.rank = rank
         self.size = size
@@ -146,13 +169,23 @@ class FileGroup(ProcessGroup):
             os.chmod(root, 0o700)
         except OSError:
             pass
+        import uuid as _uuid
+
         self._seq = 0
+        self._me = _uuid.uuid4().hex[:12]  # instance nonce: THIS process
+        # Optional operator-provided launch identity (DDSTORE_RDV_ID or
+        # the launch_id argument): rank 0 rosters only hellos carrying
+        # the same id, so a straggler rank from a PREVIOUS launch that
+        # converges to this launch's marker can never win a rank slot.
+        # Without an id (default), such a straggler is indistinguishable
+        # from a legitimately slow rank of this launch.
+        if launch_id is None:
+            launch_id = os.environ.get("DDSTORE_RDV_ID")
+        self._launch = launch_id
         marker = os.path.join(root, "MARKER")
         if rank == 0:
-            import uuid as _uuid
-
             for f in os.listdir(root):
-                if f.endswith(".pkl") or f == "MARKER":
+                if f.endswith((".pkl", ".tmp")) or f == "MARKER":
                     try:
                         os.unlink(os.path.join(root, f))
                     except OSError:
@@ -164,32 +197,121 @@ class FileGroup(ProcessGroup):
             os.replace(tmp, marker)
         else:
             self._run = self._read_marker(marker, time.time() + timeout)
-        # Hello phase: everyone publishes {run}.hello.{rank} and waits for
-        # the full set, re-reading the marker while waiting — a rank that
-        # raced ahead and picked up the PREVIOUS run's marker converges to
-        # rank 0's fresh nonce instead of timing out.
+        # Hello phase with a liveness proof. Every rank publishes
+        # {run}.hello.{rank} holding its instance nonce; rank 0 collects
+        # the full set and answers with {run}.roster listing the nonces
+        # it saw; a non-zero rank completes only when a roster NAMES ITS
+        # OWN NONCE. File existence alone is not enough: a reused
+        # directory can hold a previous launch's complete hello set (and
+        # roster, and payloads), and completing against those would read
+        # a dead generation's data as live. A stale roster cannot name a
+        # fresh process's nonce, so late rank-0 arrival just makes the
+        # others wait, re-reading the marker (and re-publishing their
+        # hellos) until the fresh generation acknowledges them.
         deadline = time.time() + timeout
-        written_for = None
+        written_for = last_run = None
+        conflict = False
+        spins = 0
+        rostered: Dict[int, str] = {}   # rank 0: admitted so far
+        mismatched: set = set()         # rank 0: hellos with a foreign id
         while True:
             if written_for != self._run:
-                hello = os.path.join(root, f"{self._run}.hello.{self.rank}.pkl")
-                with open(hello + ".tmp", "w") as fh:
-                    fh.write("x")
-                os.replace(hello + ".tmp", hello)
-                written_for = self._run
-            missing = [r for r in range(size) if not os.path.exists(
-                os.path.join(root, f"{self._run}.hello.{r}.pkl"))]
-            if not missing:
-                break
+                hello = os.path.join(root,
+                                     f"{self._run}.hello.{self.rank}.pkl")
+                # Per-process tmp name: two processes competing for one
+                # rank slot (zombie straggler) write the same final path
+                # but must never collide on the staging file; and a new
+                # launch's wipe can unlink the staging file mid-publish —
+                # that's a retry, not a crash.
+                tmp_h = f"{hello}.{self._me}.tmp"
+                try:
+                    with open(tmp_h, "wb") as fh:
+                        pickle.dump((self._launch, self._me), fh)
+                    os.replace(tmp_h, hello)
+                except OSError:
+                    if self._current_run() == self._run:
+                        raise  # real I/O failure (ENOSPC, EACCES, ...)
+                    # wiped by a newer launch mid-publish (marker gone or
+                    # replaced); converge via the marker re-read below
+                else:
+                    written_for = self._run
+                if last_run != self._run:
+                    conflict = False  # that conflict was a prior run's
+                    last_run = self._run
+            if rank == 0:
+                # Admission is first-match-wins per rank, so already-
+                # rostered entries never need re-reading (a later
+                # overwrite by a squatter changes nothing).
+                for r in range(size):
+                    if r in rostered:
+                        continue
+                    p = os.path.join(root, f"{self._run}.hello.{r}.pkl")
+                    try:
+                        with open(p, "rb") as fh:
+                            lid, nonce = pickle.load(fh)
+                    except (OSError, EOFError, pickle.UnpicklingError,
+                            TypeError, ValueError):
+                        continue
+                    if lid == self._launch:
+                        rostered[r] = nonce
+                        mismatched.discard(r)
+                    else:
+                        mismatched.add(r)
+                if len(rostered) == size:
+                    rpath = os.path.join(root, f"{self._run}.roster.pkl")
+                    with open(rpath + ".tmp", "wb") as fh:
+                        pickle.dump(rostered, fh)
+                    os.replace(rpath + ".tmp", rpath)
+                    break
+            else:
+                try:
+                    with open(os.path.join(
+                            root, f"{self._run}.roster.pkl"), "rb") as fh:
+                        roster = pickle.load(fh)
+                    ours = roster.get(self.rank)
+                    if ours == self._me:
+                        break
+                    # A roster naming someone else for our rank is either
+                    # a dead generation's leftover (resolved when rank 0's
+                    # fresh marker lands) or a live conflict (duplicate
+                    # rank / zombie). Indistinguishable from files alone —
+                    # keep waiting, and diagnose on timeout.
+                    conflict = conflict or ours is not None
+                except (OSError, EOFError, pickle.UnpicklingError):
+                    pass
             if time.time() > deadline:
-                raise TimeoutError(
-                    f"FileGroup hello: missing ranks {missing} in {root}")
+                missing = [r for r in range(size) if not os.path.exists(
+                    os.path.join(root, f"{self._run}.hello.{r}.pkl"))]
+                detail = (f"missing hello from ranks {missing}" if missing
+                          else "all hello files present but not admitted"
+                          if rank == 0 else
+                          "roster present but names another process for "
+                          "this rank — duplicate rank, or a zombie from a "
+                          "previous launch sharing the directory"
+                          if conflict else
+                          "all hellos present, no roster from rank 0")
+                if mismatched:
+                    detail += (f"; hellos from ranks {sorted(mismatched)} "
+                               f"carried a different launch id — "
+                               f"DDSTORE_RDV_ID inconsistent across ranks, "
+                               f"or stragglers from a previous launch")
+                raise TimeoutError(f"FileGroup hello: {detail} in {root}")
             time.sleep(0.005)
-            if rank != 0:
+            spins += 1
+            if rank == 0:
+                if spins % 50 == 0:
+                    self._raise_if_stale("hello")
+            else:
                 try:
                     self._run = self._read_marker(marker, deadline)
                 except TimeoutError:
                     pass
+                if spins % 50 == 0:
+                    # Re-publish: a straggler from another launch writing
+                    # to the same rank slot can overwrite our hello; with
+                    # a launch id set, rank 0 ignores the straggler's, so
+                    # periodic rewrites guarantee ours is eventually seen.
+                    written_for = None
 
     @staticmethod
     def _read_marker(marker: str, deadline: float) -> str:
@@ -205,17 +327,46 @@ class FileGroup(ProcessGroup):
                 raise TimeoutError(f"FileGroup: no MARKER at {marker}")
             time.sleep(0.005)
 
+    def _publish(self, seq: int, obj: Any) -> None:
+        path = os.path.join(self.root, f"{self._run}.{seq}.{self.rank}.pkl")
+        tmp = f"{path}.{self._me}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(obj, f)
+            os.replace(tmp, path)  # atomic publish
+        except OSError:
+            # A newer launch's wipe can unlink the staging file between
+            # write and replace; diagnose that instead of surfacing a
+            # bare FileNotFoundError.
+            self._raise_if_stale(f"publish {seq}")
+            raise
+
+    def _current_run(self) -> Optional[str]:
+        try:
+            with open(os.path.join(self.root, "MARKER")) as fh:
+                return fh.read().strip() or None
+        except OSError:
+            return None  # mid-wipe: rank 0 deleted it, new one imminent
+
+    def _raise_if_stale(self, context: str) -> None:
+        """Fail fast when a NEW launch took the directory: the marker no
+        longer holds this group's nonce. A missing/mid-wipe marker (None)
+        is not treated as takeover — the next read resolves it."""
+        run = self._current_run()
+        if run is not None and run != self._run:
+            raise TimeoutError(
+                f"FileGroup {context}: rendezvous generation changed "
+                f"under a live run — this rank is stale (a new world "
+                f"launched in {self.root})")
+
     def allgather(self, obj: Any) -> List[Any]:
         seq = self._seq
         self._seq += 1
-        path = os.path.join(self.root, f"{self._run}.{seq}.{self.rank}.pkl")
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(obj, f)
-        os.replace(tmp, path)  # atomic publish
+        self._publish(seq, obj)
         deadline = time.time() + self.timeout
         result: List[Any] = [None] * self.size
         pending = set(range(self.size))
+        spins = 0
         while pending:
             for r in list(pending):
                 p = os.path.join(self.root, f"{self._run}.{seq}.{r}.pkl")
@@ -223,14 +374,28 @@ class FileGroup(ProcessGroup):
                     try:
                         with open(p, "rb") as f:
                             result[r] = pickle.load(f)
-                    except (EOFError, pickle.UnpicklingError):
-                        continue  # writer mid-replace on some filesystems
+                    except (FileNotFoundError, EOFError,
+                            pickle.UnpicklingError):
+                        # writer mid-replace, or a new launch's wipe
+                        # unlinked the file between exists() and open();
+                        # the generation check below diagnoses the latter.
+                        # Other OSErrors (EIO, EACCES) propagate — they
+                        # are real failures, not races.
+                        continue
                     pending.discard(r)
             if pending:
                 if time.time() > deadline:
                     raise TimeoutError(
                         f"FileGroup allgather {seq}: missing ranks {pending}")
                 time.sleep(0.005)
+                spins += 1
+                if spins % 50 == 0:
+                    # Every rank, including 0 (which wrote this run's
+                    # marker itself): membership is roster-gated at
+                    # construction, so a nonce change mid-collective
+                    # means a NEW world launched in this directory and
+                    # this process belongs to the dead one.
+                    self._raise_if_stale(f"allgather {seq}")
         return result
 
     def split(self, color: int) -> "ProcessGroup":
@@ -238,7 +403,7 @@ class FileGroup(ProcessGroup):
         members = [r for r, c in enumerate(colors) if c == color]
         sub = FileGroup(os.path.join(self.root, f"s{self._seq}c{color}"),
                         members.index(self.rank), len(members),
-                        self.timeout)
+                        self.timeout, launch_id=self._launch)
         return sub
 
 

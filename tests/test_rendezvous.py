@@ -1,0 +1,128 @@
+"""FileGroup rendezvous protocol: staleness, takeover, and launch
+identity. Threads are enough — the protocol is purely filesystem-based —
+and keep these scenarios deterministic (the multi-process stale-directory
+end-to-end case lives in test_store_tcp.py)."""
+
+import os
+import pickle
+import threading
+import time
+
+import pytest
+
+from ddstore_tpu import FileGroup
+
+
+def _run_member(results, key, *args, **kwargs):
+    try:
+        g = FileGroup(*args, **kwargs)
+        results[key] = ("ok", g.allgather(key))
+    except Exception as e:  # noqa: BLE001
+        results[key] = ("err", str(e))
+
+
+def test_world_forms_and_allgathers(tmp_path):
+    results = {}
+    ts = [threading.Thread(target=_run_member,
+                           args=(results, f"r{r}", str(tmp_path), r, 3))
+          for r in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert all(v[0] == "ok" for v in results.values()), results
+    assert results["r0"][1] == ["r0", "r1", "r2"]
+
+
+def test_launch_id_excludes_cross_launch_straggler(tmp_path):
+    """A straggler rank 1 from launch A (its own rank 0 never arrived)
+    converges to launch B's fresh marker and competes for the rank-1
+    slot. With per-launch ids, rank 0 must roster launch B's rank 1 —
+    whichever order the hello overwrites land in — and the straggler
+    must time out with the slot-conflict diagnostic."""
+    results = {}
+    zombie = threading.Thread(
+        target=_run_member,
+        args=(results, "zombie", str(tmp_path), 1, 2),
+        kwargs={"timeout": 10.0, "launch_id": "A"})
+    zombie.start()
+    time.sleep(0.3)  # straggler is parked waiting for a marker
+    ts = [threading.Thread(
+        target=_run_member,
+        args=(results, f"b{r}", str(tmp_path), r, 2),
+        kwargs={"timeout": 30.0, "launch_id": "B"}) for r in (0, 1)]
+    ts[0].start()
+    time.sleep(0.3)  # let the straggler adopt the marker first
+    ts[1].start()
+    for t in ts:
+        t.join(timeout=60)
+    zombie.join(timeout=30)
+    assert results["b0"][0] == "ok", results
+    assert results["b1"][0] == "ok", results
+    assert results["b0"][1] == ["b0", "b1"]
+    assert results["zombie"][0] == "err", results
+    assert "another process" in results["zombie"][1], results
+
+
+def test_allgather_fails_fast_when_new_world_takes_directory(tmp_path):
+    """A live world whose directory is wiped and re-marked by a NEW
+    launch must fail its in-flight collective promptly with the
+    generation-changed diagnosis, not burn the full timeout."""
+    results = {}
+
+    def member(rank):
+        t0 = time.time()
+        try:
+            g = FileGroup(str(tmp_path), rank, 2, timeout=60.0)
+            g.allgather(rank)  # world forms normally
+            if rank == 0:
+                # Simulate launch N+1's rank 0 taking the directory.
+                time.sleep(0.5)
+                for f in os.listdir(tmp_path):
+                    if f.endswith(".pkl"):
+                        os.unlink(os.path.join(tmp_path, f))
+                with open(os.path.join(tmp_path, "MARKER"), "w") as fh:
+                    fh.write("feedfacefeed")
+                results[rank] = ("ok", None)
+            else:
+                t0 = time.time()  # exclude the (normal) join time
+                g.allgather("never-completes")
+                results[rank] = ("ok", None)
+        except TimeoutError as e:
+            results[rank] = ("err", str(e), time.time() - t0)
+
+    ts = [threading.Thread(target=member, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=90)
+    assert results[0][0] == "ok", results
+    assert results[1][0] == "err", results
+    assert "generation changed" in results[1][1], results
+    assert results[1][2] < 30.0, results  # fail-fast, not the full timeout
+
+
+def test_tmp_litter_is_wiped_on_fresh_launch(tmp_path):
+    """Crashed writers leave *.pkl.tmp / MARKER.tmp behind; rank 0's
+    construction wipe must clear them so a reused directory does not
+    accumulate litter without bound."""
+    (tmp_path / "deadbeef.hello.3.pkl.tmp").write_text("x")
+    (tmp_path / "MARKER.tmp").write_text("x")
+    FileGroup(str(tmp_path), 0, 1)
+    left = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert left == [], left
+
+
+def test_stale_roster_never_admits_fresh_process(tmp_path):
+    """Unit form of the reuse race: a complete dead generation on disk
+    (marker, hellos, roster) must not admit a fresh process — it waits
+    for the live rank 0 instead of consuming dead state."""
+    stale = "deadc0dedead"
+    (tmp_path / "MARKER").write_text(stale)
+    for r in range(2):
+        (tmp_path / f"{stale}.hello.{r}.pkl").write_bytes(
+            pickle.dumps((None, f"deadbeef{r:04d}")))
+    (tmp_path / f"{stale}.roster.pkl").write_bytes(
+        pickle.dumps({0: "deadbeef0000", 1: "deadbeef0001"}))
+    with pytest.raises(TimeoutError):
+        FileGroup(str(tmp_path), 1, 2, timeout=3.0)

@@ -310,3 +310,51 @@ def test_tcp_init_update(tmp_path):
 
 def test_tcp_replica_width(tmp_path):
     _spawn(4, _worker_width, str(tmp_path))
+
+
+def _worker_stale_rdv(rank, world, tmp, q):
+    try:
+        import time
+
+        from ddstore_tpu import DDStore, FileGroup
+
+        if rank == 0:
+            # Rank 0 arrives late: the non-zero rank must first complete
+            # hello against the pre-populated DEAD generation, then
+            # re-home when rank 0 wipes and publishes the fresh nonce.
+            time.sleep(2.0)
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp") as s:
+            s.add("d", np.full((NUM, DIM), rank + 1, np.float64))
+            row = s.get("d", (rank + 1) % world * NUM)[0]
+            assert row.mean() == (rank + 1) % world + 1, row.mean()
+        q.put((rank, None))
+    except Exception:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
+def test_tcp_reused_rdv_dir_with_stale_generation(tmp_path):
+    """Launch into a rendezvous dir still holding EVERYTHING a completed
+    previous launch leaves behind — marker, hello set, roster, allgather
+    payloads (the auto_group default dir is reused across runs). Without
+    the roster liveness proof, rank 1 adopts the dead marker, completes
+    hello against the dead files, and consumes the dead generation's
+    endpoint exchange as live data while the late rank 0 wipes and waits
+    on a fresh hello forever."""
+    import pickle
+
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    stale = "deadc0dedead"
+    (rdv / "MARKER").write_text(stale)
+    roster = {}
+    for r in range(2):
+        roster[r] = f"deadbeef{r:04d}"
+        (rdv / f"{stale}.hello.{r}.pkl").write_bytes(
+            pickle.dumps(roster[r]))
+        # A plausible dead endpoint exchange: ports nothing listens on.
+        (rdv / f"{stale}.0.{r}.pkl").write_bytes(
+            pickle.dumps(("127.0.0.1", 1)))
+    (rdv / f"{stale}.roster.pkl").write_bytes(pickle.dumps(roster))
+    _spawn(2, _worker_stale_rdv, str(tmp_path))
