@@ -92,6 +92,17 @@ def test_load_mnist(tmp_path, gz):
     np.testing.assert_array_equal(y, labels.astype(np.int32))
 
 
+def test_load_mnist_raw_uint8(tmp_path):
+    # normalize=False keeps the idx files' raw pixels: what the example
+    # and bench register in the store (4x fewer bytes; the VAE step
+    # dequantizes on device).
+    images, _labels = _write_mnist_fixture(str(tmp_path), n=16)
+    x, y = load_mnist(str(tmp_path), normalize=False)
+    assert x.shape == (16, 784) and x.dtype == np.uint8
+    assert y.dtype == np.int32
+    np.testing.assert_array_equal(x, images.reshape(16, -1))
+
+
 def test_load_mnist_missing(tmp_path):
     assert find_mnist(str(tmp_path)) is None
     with pytest.raises(FileNotFoundError):
