@@ -177,8 +177,13 @@ class FileGroup(ProcessGroup):
         # the launch_id argument): rank 0 rosters only hellos carrying
         # the same id, so a straggler rank from a PREVIOUS launch that
         # converges to this launch's marker can never win a rank slot.
-        # Without an id (default), such a straggler is indistinguishable
-        # from a legitimately slow rank of this launch.
+        # Deliberately NOT auto-sourced from scheduler job ids: an
+        # elastic replacement rank may run under a different batch job
+        # than the survivors (it must still join), and relaunches inside
+        # one allocation share the job id (no protection anyway) — only
+        # the operator knows what constitutes "one launch". Without an
+        # id (default), a straggler is indistinguishable from a
+        # legitimately slow rank of this launch.
         if launch_id is None:
             launch_id = os.environ.get("DDSTORE_RDV_ID")
         self._launch = launch_id
