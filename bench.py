@@ -860,7 +860,6 @@ def profile_lm_long(outdir, steps=3):
     tensorboard or xprof."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     from ddstore_tpu.models import transformer
 

@@ -10,7 +10,6 @@ matmuls on the MXU.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, NamedTuple, Optional, Tuple
 
 import flax.linen as nn

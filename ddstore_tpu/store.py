@@ -20,8 +20,8 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from .binding import DDStoreError, NativeStore
-from .rendezvous import (FileGroup, JaxGroup, ProcessGroup, SingleGroup,
-                         ThreadGroup, auto_group)
+from .rendezvous import (ProcessGroup, SingleGroup, ThreadGroup,
+                         auto_group)
 
 __all__ = ["DDStore", "DDStoreError"]
 

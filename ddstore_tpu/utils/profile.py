@@ -14,7 +14,7 @@ unconditionally.
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+from typing import Iterator
 
 __all__ = ["trace", "annotate", "step_annotate"]
 

@@ -5,8 +5,6 @@ detects the same scheduler families plus GKE/GCE TPU metadata env and
 feeds jax.distributed.initialize. Detection is a pure function of an env
 dict, so every path is testable by fake here."""
 
-import pytest
-
 from ddstore_tpu import (SingleGroup, detect_pod_env, parse_nodelist,
                          pod_bootstrap)
 

@@ -4,7 +4,6 @@ the analogue of the reference's ``mpirun -n 4 python test/demo.py`` strategy
 
 import multiprocessing as mp
 import os
-import sys
 
 import numpy as np
 import pytest
