@@ -1026,6 +1026,7 @@ def main():
     extras = {}
     failed = []
     skipped = []
+    phase_s = {}
     for name, _ in _PHASES:
         if name in ("lm", "lmlong", "attnlong") and "numerics" in failed:
             # The numerics phase did not certify flash==reference on
@@ -1043,6 +1044,7 @@ def main():
                   file=sys.stderr)
             skipped.append(name)
             continue
+        t_phase = time.monotonic()
         try:
             # Own session: a timeout must kill the phase's WHOLE process
             # group (the tcp phase spawns multiprocessing ranks that
@@ -1078,6 +1080,11 @@ def main():
             failed.append(name)
             print(f"# phase {name} FAILED ({type(e).__name__}): "
                   f"{str(e)[:200]}", file=sys.stderr)
+        finally:
+            phase_s[name] = round(time.monotonic() - t_phase, 1)
+    # Wall time per phase: when the deadline cuts the tail, the record
+    # itself shows which phases consumed the budget.
+    extras["phase_seconds"] = phase_s
     if failed:
         extras["failed_phases"] = failed
     if skipped:
