@@ -1,0 +1,21 @@
+# Developer entry points. The native core normally builds itself lazily
+# (first binding import compiles ddstore_tpu/native/*.cc when the cached
+# .so is stale), but an explicit, reproducible rebuild matters for CI and
+# for iterating on the C++: `make native` is the one command, and tier-1
+# conftest.py runs the same stale check before the suite starts.
+
+PYTHON ?= python
+
+.PHONY: native native-force clean-native test
+
+native:
+	$(PYTHON) -m ddstore_tpu._build
+
+native-force:
+	$(PYTHON) -m ddstore_tpu._build --force
+
+clean-native:
+	rm -f ddstore_tpu/_lib/*.so
+
+test: native
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'

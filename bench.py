@@ -194,6 +194,13 @@ def _tcp_worker(rank, world, rdv, outfile, num, dim):
                     for k, v in s._native.routing_state().items():
                         res[f"route_{k}"] = round(v, 3) \
                             if isinstance(v, float) else v
+                # Scatter-read planner statistics (cumulative over this
+                # worker's reads): how well get_batch coalesced/deduped
+                # the scattered workloads above — runs per peer list,
+                # coalesce ratio, dedup hits land in bench extras so a
+                # planner regression is visible from the JSON alone.
+                for k, v in s.plan_stats().items():
+                    res[k] = round(v, 3) if isinstance(v, float) else v
             s.barrier()
             # Fence latency: everyone participates, rank 0 times it.
             t0 = time.perf_counter()
@@ -292,7 +299,18 @@ def tcp_microbench(world=4, num=65536, dim=64):
           "route_tcp_scatter_gbps": "route_tcp_scatter_gbps",
           "route_scatter_decisions": "route_scatter_decisions",
           "route_scatter_crossovers": "route_scatter_crossovers",
-          "route_scatter_via_tcp": "route_scatter_via_tcp"}),
+          "route_scatter_via_tcp": "route_scatter_via_tcp",
+          "route_uds_conns": "route_uds_conns",
+          "plan_batches": "plan_batches",
+          "plan_rows": "plan_rows",
+          "plan_runs": "plan_runs",
+          "plan_local_runs": "plan_local_runs",
+          "plan_peer_lists": "plan_peer_lists",
+          "plan_dedup_hits": "plan_dedup_hits",
+          "plan_scratch_runs": "plan_scratch_runs",
+          "plan_scratch_bytes": "plan_scratch_bytes",
+          "plan_coalesce_ratio": "plan_coalesce_ratio",
+          "plan_runs_per_peer_list": "plan_runs_per_peer_list"}),
     )
     for env, keys in passes:
         rdv = tempfile.mkdtemp()
@@ -903,16 +921,23 @@ def _phase_tcp():
 
 def _phase_soak():
     # Shared harness with tests/test_tiering.py (VERDICT r4 next #5) —
-    # the bench and the regression test measure the SAME soak.
+    # the bench and the regression test measure the SAME soak. The epoch
+    # is TIME-boxed under the phase runner's own per-phase timeout
+    # (BENCH_r05 lost the whole phase to TimeoutExpired on a slow box):
+    # a truncated soak reports every number it measured, a killed one
+    # reports nothing.
     from ddstore_tpu.utils.soak import mmap_soak
 
-    m = mmap_soak()
+    budget = float(os.environ.get("DDSTORE_SOAK_BUDGET_S", 600))
+    m = mmap_soak(budget_s=budget)
     print(f"# tiering soak: {m['rows']:.0e}-row mmap shard, "
-          f"{m['rows_per_s']:.0f} rows/s batched, RSS "
+          f"{m['rows_per_s']:.0f} rows/s batched over "
+          f"{m['batches_run']} batches, RSS "
           f"+{m['rss_delta_mb']:.0f} MB, sentinels "
           f"{'ok' if m['sentinels_ok'] else 'BAD'}", file=sys.stderr)
     return {"soak_rows": m["rows"],
             "soak_rows_per_s": round(m["rows_per_s"], 0),
+            "soak_batches_run": m["batches_run"],
             "soak_rss_delta_mb": round(m["rss_delta_mb"], 1),
             "soak_sentinels_ok": m["sentinels_ok"]}
 
@@ -1026,9 +1051,21 @@ def main():
         import signal
         signal.alarm(int(float(os.environ.get(
             "DDSTORE_BENCH_PROBE_TIMEOUT_S", 300))) + 60)
-        _pin_platform()
-        import jax
-        sys.exit(0 if jax.devices() else 1)
+        # A platform-INIT error (bad plugin, misconfigured runtime) must
+        # exit(1) with one readable line, not an uncaught traceback: the
+        # parent only sees the return code either way, but the stderr
+        # line is what distinguishes "config error" from "accelerator
+        # outage" in the run log.
+        try:
+            _pin_platform()
+            import jax
+            devs = jax.devices()
+        except Exception as e:
+            msg = str(e).splitlines()[0] if str(e) else ""
+            print(f"# probe: accelerator init failed "
+                  f"({type(e).__name__}): {msg[:200]}", file=sys.stderr)
+            sys.exit(1)
+        sys.exit(0 if devs else 1)
 
     if len(sys.argv) == 3 and sys.argv[1] == "--phase":
         _pin_platform()

@@ -76,6 +76,18 @@ def build(force: bool = False) -> str:
         cmd += [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
         # Build to a temp path then rename: concurrent test processes may
         # race on the build, and dlopen of a half-written .so is fatal.
+        # A build killed between mkstemp and the finally below leaks its
+        # staging file (the observed _lib/tmp*.so strays). Sweep old ones
+        # here: anything past an hour cannot belong to a live concurrent
+        # build, whose compile takes seconds.
+        import glob
+        import time as _time
+        for stray in glob.glob(os.path.join(_LIB_DIR, "tmp*.so")):
+            try:
+                if _time.time() - os.path.getmtime(stray) > 3600:
+                    os.unlink(stray)
+            except OSError:
+                pass
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
         os.close(fd)
         try:
@@ -89,3 +101,22 @@ def build(force: bool = False) -> str:
             if os.path.exists(tmp):
                 os.unlink(tmp)
         return lib_path
+
+
+def main(argv=None) -> None:
+    """``python -m ddstore_tpu._build`` (or ``make native``): the
+    reproducible rebuild entry — compiles iff a native source is newer
+    than the cached library and prints the library path either way."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m ddstore_tpu._build",
+        description="Build the native ddstore_tpu core (stale-aware).")
+    ap.add_argument("--force", action="store_true",
+                    help="rebuild even when the cached .so is fresh")
+    args = ap.parse_args(argv)
+    print(build(force=args.force))
+
+
+if __name__ == "__main__":
+    main()

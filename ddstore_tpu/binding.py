@@ -89,6 +89,10 @@ def _load() -> ctypes.CDLL:
     lib.dds_barrier.argtypes = [ctypes.c_void_p, _i64]
     lib.dds_cma_ops.restype = _i64
     lib.dds_cma_ops.argtypes = [ctypes.c_void_p]
+    lib.dds_uds_conns.restype = _i64
+    lib.dds_uds_conns.argtypes = [ctypes.c_void_p]
+    lib.dds_plan_stats.restype = ctypes.c_int
+    lib.dds_plan_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_rank.restype = ctypes.c_int
     lib.dds_rank.argtypes = [ctypes.c_void_p]
     lib.dds_world.restype = ctypes.c_int
@@ -204,6 +208,9 @@ class NativeStore:
                         f"{label}_decisions": dec.value,
                         f"{label}_crossovers": cro.value,
                         f"{label}_via_tcp": bool(via.value)})
+        # Same-host Unix-lane dials: whether loopback peers actually took
+        # the UDS fast lane or silently fell back to loopback TCP.
+        out["uds_conns"] = self._lib.dds_uds_conns(self._h)
         return out
 
     @property
@@ -299,9 +306,38 @@ class NativeStore:
 
     @property
     def cma_ops(self) -> int:
-        """Reads served via the same-host CMA (process_vm_readv) fast
-        path; 0 for non-TCP backends or when DDSTORE_CMA=0."""
+        """Reads served via the same-host CMA fast path (shared-memory
+        mapped gather, or process_vm_readv for borrowed shards); 0 for
+        non-TCP backends or when DDSTORE_CMA=0."""
         return self._lib.dds_cma_ops(self._h)
+
+    def plan_stats(self) -> dict:
+        """Cumulative scatter-read planner statistics (``get_batch``):
+        batches/rows planned, coalesced runs emitted (local + per-peer),
+        remote per-peer run lists issued, duplicate rows served by
+        post-fetch replication, and scratch staging volume. Derived:
+        ``coalesce_ratio`` = unique rows fetched per transport run (1.0 =
+        nothing coalesced; higher = fewer, larger segments on the wire)."""
+        arr = (ctypes.c_int64 * 8)()
+        _check(self._lib.dds_plan_stats(self._h, arr), "plan_stats")
+        (batches, rows, runs, local_runs, peer_lists, dedup_hits,
+         scratch_runs, scratch_bytes) = list(arr)
+        raw = {
+            "plan_batches": batches,
+            "plan_rows": rows,
+            "plan_runs": runs,
+            "plan_local_runs": local_runs,
+            "plan_peer_lists": peer_lists,
+            "plan_dedup_hits": dedup_hits,
+            "plan_scratch_runs": scratch_runs,
+            "plan_scratch_bytes": scratch_bytes,
+        }
+        # Deriving the ratios via a zero-baseline delta keeps their
+        # definitions single-sourced in utils.metrics (lazy import:
+        # binding must stay importable before the package's siblings).
+        from .utils.metrics import plan_stats_delta
+
+        return plan_stats_delta({}, raw)
 
     @property
     def rank(self) -> int:

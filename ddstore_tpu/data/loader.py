@@ -106,6 +106,12 @@ class DeviceLoader:
                 and not getattr(transform, "thread_safe", False)):
             self._transform_lock = threading.Lock()
         self.metrics = PipelineMetrics()
+        # Store-backed datasets expose their DDStore; wiring its planner
+        # counters in gives every epoch summary the scatter-read plan view
+        # (runs/peer, coalesce ratio, dedup hits) alongside the latencies.
+        store = getattr(dataset, "store", None)
+        if store is not None and hasattr(store, "plan_stats"):
+            self.metrics.set_plan_source(store.plan_stats)
         if mesh is not None and jax is None:  # pragma: no cover
             raise RuntimeError("jax unavailable but mesh given")
         # `spec` overrides the default leading-dim-over-`axis` layout, e.g.

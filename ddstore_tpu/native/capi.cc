@@ -160,6 +160,28 @@ int64_t dds_cma_ops(dds_handle* h) {
   return h && h->tcp ? h->tcp->cma_ops() : 0;
 }
 
+int64_t dds_uds_conns(dds_handle* h) {
+  return h && h->tcp ? h->tcp->uds_conns() : 0;
+}
+
+// Scatter-read planner statistics (cumulative; see dds::PlanStats). `out`
+// receives [batches, rows, runs, local_runs, peer_lists, dedup_hits,
+// scratch_runs, scratch_bytes] — a flat array so the ctypes binding stays
+// struct-layout-agnostic.
+int dds_plan_stats(dds_handle* h, int64_t out[8]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  dds::PlanStats s = h->store->plan_stats();
+  out[0] = s.batches;
+  out[1] = s.rows;
+  out[2] = s.runs;
+  out[3] = s.local_runs;
+  out[4] = s.peer_lists;
+  out[5] = s.dedup_hits;
+  out[6] = s.scratch_runs;
+  out[7] = s.scratch_bytes;
+  return dds::kOk;
+}
+
 int dds_rank(dds_handle* h) { return h ? h->store->rank() : -1; }
 int dds_world(dds_handle* h) { return h ? h->store->world() : -1; }
 

@@ -2,6 +2,9 @@
 
 #include <algorithm>
 #include <cstring>
+#include <memory>
+
+#include "worker_pool.h"
 
 namespace dds {
 
@@ -62,7 +65,9 @@ int Store::AddInternal(const std::string& name, const void* buf, int64_t nrows,
 
   int64_t bytes = nrows * disp * itemsize;
   if (zero_fill || copy) {
-    v.base = static_cast<char*>(bytes ? ::malloc(bytes) : ::malloc(1));
+    // Owned allocations go through the transport so a same-host fast path
+    // can back them with shareable memory (see Transport::AllocShard).
+    v.base = static_cast<char*>(transport_->AllocShard(name, bytes));
     if (!v.base) return kErrNoMem;
     v.owned = true;
     if (zero_fill) {
@@ -132,11 +137,16 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
 }
 
 namespace {
-struct Run {  // a coalesced contiguous read
+// One planned contiguous run: `nrows` source-adjacent rows in `target`'s
+// shard. `first` indexes the sorted (row, slot) table; the run covers
+// sorted entries [first, first+nrows), whose slots give each row's final
+// position in dst.
+struct Run {
   int target;
   int64_t offset;   // byte offset in target's shard
-  int64_t nbytes;
-  int64_t dst_off;  // byte offset in dst
+  int64_t nrows;
+  int64_t first;    // index of the run's first entry in the sorted table
+  bool direct;      // output slots are contiguous too: read straight to dst
 };
 }  // namespace
 
@@ -148,57 +158,174 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   if (!GetVarInfo(name, &v)) return kErrNotFound;
   const int64_t rb = v.row_bytes();
   const int64_t total = v.total_rows();
+  char* out = static_cast<char*>(dst);
 
-  // Build coalesced runs: consecutive requested rows that are globally
-  // adjacent and share an owner merge into one transport read.
-  std::vector<Run> runs;
-  runs.reserve(n);
+  // -- Plan -----------------------------------------------------------------
+  // Sort (row, output slot) so source-adjacent rows coalesce regardless of
+  // request order, duplicates become neighbors (fetch once, replicate
+  // after), and every peer's run list comes out offset-sorted — the
+  // sequential access pattern the transports and the owner's page cache
+  // like best.
+  std::vector<std::pair<int64_t, int64_t>> order;  // (row, slot)
+  order.reserve(n);
   for (int64_t i = 0; i < n; ++i) {
-    int64_t row = starts[i];
+    const int64_t row = starts[i];
     if (row < 0 || row >= total) return kErrOutOfRange;
-    int target = OwnerOf(v.cum, row);
-    int64_t shard_begin = target == 0 ? 0 : v.cum[target - 1];
-    int64_t off = (row - shard_begin) * rb;
+    order.emplace_back(row, i);
+  }
+  std::sort(order.begin(), order.end());
+
+  // Duplicate rows: keep the first occurrence in `order` (compacted in
+  // place), remember the rest as post-fetch replications.
+  struct Replica {
+    int64_t src_slot, dst_slot;
+  };
+  std::vector<Replica> replicas;
+  int64_t uniq = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (uniq > 0 && order[uniq - 1].first == order[i].first) {
+      replicas.push_back(Replica{order[uniq - 1].second, order[i].second});
+    } else {
+      order[uniq++] = order[i];
+    }
+  }
+  order.resize(uniq);
+
+  // Coalesce: rows adjacent in the (sorted) global space that share an
+  // owner merge into one run. Owners are found with a forward-moving
+  // cursor — sorted rows make the per-row binary search redundant.
+  std::vector<Run> runs;
+  runs.reserve(uniq);
+  int cursor = 0;  // owner of the previous row; owners are nondecreasing
+  for (int64_t i = 0; i < uniq; ++i) {
+    const int64_t row = order[i].first;
+    while (cursor < world() && row >= v.cum[cursor]) ++cursor;
+    const int64_t shard_begin = cursor == 0 ? 0 : v.cum[cursor - 1];
+    const int64_t off = (row - shard_begin) * rb;
     if (!runs.empty()) {
       Run& last = runs.back();
-      if (last.target == target && last.offset + last.nbytes == off &&
-          last.dst_off + last.nbytes == i * rb) {
-        last.nbytes += rb;
+      if (last.target == cursor &&
+          last.offset + last.nrows * rb == off) {
+        last.direct = last.direct &&
+            order[i].second == order[i - 1].second + 1;
+        ++last.nrows;
         continue;
       }
     }
-    runs.push_back(Run{target, off, rb, i * rb});
+    runs.push_back(Run{cursor, off, 1, i, /*direct=*/true});
   }
 
-  // Partition runs by peer; serve local runs in one vectored call (one
-  // lock + lookup for the whole batch), then hand ALL remote peers' run
-  // lists to the transport in one ReadVMulti — concurrency across peers
-  // (and across striped connections within a peer) comes from the
-  // transport's persistent worker pool, not from per-call thread spawns.
+  // -- Materialize ----------------------------------------------------------
+  // Direct runs read straight into their contiguous dst span. Scattered
+  // runs (source-contiguous, dst not) stage through one scratch block and
+  // are memcpy'd out afterwards: one big transport segment plus k small
+  // host copies beats k transport segments everywhere a segment costs
+  // more than a memcpy (syscalls, wire framing, per-iovec kernel walks).
+  int64_t scratch_bytes = 0;
+  for (const Run& r : runs)
+    if (!r.direct) scratch_bytes += r.nrows * rb;
+  // new char[] (not vector): every byte is about to be overwritten by
+  // the transport reads, and a value-initializing container would pay a
+  // full extra memory pass per batch on the hot path.
+  std::unique_ptr<char[]> scratch(
+      scratch_bytes ? new char[static_cast<size_t>(scratch_bytes)]
+                    : nullptr);
+
   std::map<int, std::vector<ReadOp>> by_peer;
   std::vector<ReadOp> local_ops;
-  char* out = static_cast<char*>(dst);
+  std::vector<std::pair<const Run*, char*>> fixups;  // scratch scatter list
+  int64_t spos = 0;
+  int64_t local_runs = 0;
   for (const Run& r : runs) {
-    if (r.target == rank()) {
-      local_ops.push_back(ReadOp{r.offset, r.nbytes, out + r.dst_off});
+    char* rdst;
+    if (r.direct) {
+      rdst = out + order[r.first].second * rb;
     } else {
-      by_peer[r.target].push_back(ReadOp{r.offset, r.nbytes, out + r.dst_off});
+      rdst = scratch.get() + spos;
+      spos += r.nrows * rb;
+      fixups.emplace_back(&r, rdst);
+    }
+    if (r.target == rank()) {
+      ++local_runs;
+      local_ops.push_back(ReadOp{r.offset, r.nrows * rb, rdst});
+    } else {
+      by_peer[r.target].push_back(ReadOp{r.offset, r.nrows * rb, rdst});
     }
   }
-  if (!local_ops.empty()) {
-    int rc = ReadLocalV(name, local_ops.data(),
-                        static_cast<int64_t>(local_ops.size()));
-    if (rc != kOk) return rc;
-  }
-  if (by_peer.empty()) return kOk;
 
-  std::vector<PeerReadV> reqs;
-  reqs.reserve(by_peer.size());
-  for (auto& kv : by_peer)
-    reqs.push_back(PeerReadV{kv.first, kv.second.data(),
-                             static_cast<int64_t>(kv.second.size())});
-  return transport_->ReadVMulti(name, reqs.data(),
-                                static_cast<int64_t>(reqs.size()));
+  {
+    std::lock_guard<std::mutex> lock(stats_mu_);
+    ++stats_.batches;
+    stats_.rows += n;
+    stats_.runs += static_cast<int64_t>(runs.size());
+    stats_.local_runs += local_runs;
+    stats_.peer_lists += static_cast<int64_t>(by_peer.size());
+    stats_.dedup_hits += static_cast<int64_t>(replicas.size());
+    stats_.scratch_runs += static_cast<int64_t>(fixups.size());
+    stats_.scratch_bytes += scratch_bytes;
+  }
+
+  // -- Execute --------------------------------------------------------------
+  // Local runs in one vectored call (one lock + lookup for the whole
+  // batch); ALL remote peers' run lists in one ReadVMulti — concurrency
+  // across peers (and across striped connections within a peer) comes
+  // from the transport's persistent worker pool, not per-call threads.
+  // When a batch has BOTH legs and the local one is big enough to matter,
+  // the local copies ride the transport's persistent pool so they overlap
+  // the remote transfer instead of delaying its dispatch (a shuffled
+  // batch is ~1/world local: at world=4 that's ~0.5 MiB of serial memcpy
+  // ahead of every remote fan-out). The task is a flat leaf queued BEFORE
+  // ReadVMulti's own leaves, so it cannot deadlock the pool.
+  constexpr int64_t kOverlapMinLocalBytes = 64 << 10;
+  int64_t local_bytes = 0;
+  for (const ReadOp& op : local_ops) local_bytes += op.nbytes;
+  WorkerPool* pool = by_peer.empty() ? nullptr : transport_->worker_pool();
+  int local_rc = kOk;
+  std::unique_ptr<TaskGroup> local_group;
+  if (!local_ops.empty()) {
+    if (pool && local_bytes >= kOverlapMinLocalBytes) {
+      local_group.reset(new TaskGroup(pool));
+      local_group->Launch([this, &name, &local_ops, &local_rc]() {
+        local_rc = ReadLocalV(name, local_ops.data(),
+                              static_cast<int64_t>(local_ops.size()));
+      });
+    } else {
+      local_rc = ReadLocalV(name, local_ops.data(),
+                            static_cast<int64_t>(local_ops.size()));
+      if (local_rc != kOk) return local_rc;
+    }
+  }
+  if (!by_peer.empty()) {
+    std::vector<PeerReadV> reqs;
+    reqs.reserve(by_peer.size());
+    for (auto& kv : by_peer)
+      reqs.push_back(PeerReadV{kv.first, kv.second.data(),
+                               static_cast<int64_t>(kv.second.size())});
+    int rc = transport_->ReadVMulti(name, reqs.data(),
+                                    static_cast<int64_t>(reqs.size()));
+    if (rc != kOk) {
+      if (local_group) local_group->Wait();
+      return rc;
+    }
+  }
+  if (local_group) local_group->Wait();
+  if (local_rc != kOk) return local_rc;
+
+  // -- Scatter + replicate --------------------------------------------------
+  for (const auto& fx : fixups) {
+    const Run& r = *fx.first;
+    const char* src = fx.second;
+    for (int64_t k = 0; k < r.nrows; ++k)
+      std::memcpy(out + order[r.first + k].second * rb, src + k * rb, rb);
+  }
+  for (const Replica& rep : replicas)
+    std::memcpy(out + rep.dst_slot * rb, out + rep.src_slot * rb, rb);
+  return kOk;
+}
+
+PlanStats Store::plan_stats() const {
+  std::lock_guard<std::mutex> lock(stats_mu_);
+  return stats_;
 }
 
 int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,
@@ -246,7 +373,7 @@ int Store::Rebind(const std::string& name, void* base) {
   // over TCP, where this exclusive lock serializes it), publish the new
   // backing only once it is in place.
   transport_->UnpublishVar(name);
-  if (v.owned) ::free(v.base);
+  if (v.owned) transport_->FreeShard(name, v.base);
   v.base = static_cast<char*>(base);
   v.owned = false;
   transport_->PublishVar(name, v.base, v.shard_bytes());
@@ -258,7 +385,7 @@ int Store::FreeVar(const std::string& name) {
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
   transport_->UnpublishVar(name);
-  if (it->second.owned) ::free(it->second.base);
+  if (it->second.owned) transport_->FreeShard(name, it->second.base);
   vars_.erase(it);
   return kOk;
 }
@@ -267,7 +394,7 @@ int Store::FreeAll() {
   std::unique_lock<std::shared_mutex> lock(mu_);
   for (auto& kv : vars_) {
     transport_->UnpublishVar(kv.first);
-    if (kv.second.owned) ::free(kv.second.base);
+    if (kv.second.owned) transport_->FreeShard(kv.first, kv.second.base);
   }
   vars_.clear();
   return kOk;
@@ -317,14 +444,13 @@ int Store::ReadLocalV(const std::string& name, const ReadOp* ops,
   return kOk;
 }
 
-int Store::CheckLocal(const std::string& name, int64_t offset,
-                      int64_t nbytes) const {
+int Store::WithShard(const std::string& name,
+                     const std::function<int(const char*, int64_t)>& fn)
+    const {
   std::shared_lock<std::shared_mutex> lock(mu_);
   auto it = vars_.find(name);
   if (it == vars_.end()) return kErrNotFound;
-  const VarInfo& v = it->second;
-  if (RangeBad(offset, nbytes, v.shard_bytes())) return kErrOutOfRange;
-  return kOk;
+  return fn(it->second.base, it->second.shard_bytes());
 }
 
 bool Store::GetVarInfo(const std::string& name, VarInfo* out) const {

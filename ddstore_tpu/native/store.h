@@ -19,6 +19,8 @@
 #define DDSTORE_TPU_STORE_H_
 
 #include <cstdint>
+#include <cstdlib>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -76,11 +78,38 @@ struct PeerReadV {
   int64_t n;
 };
 
+// Cumulative scatter-read planner statistics (GetBatch). All counters are
+// monotone since store creation; consumers diff snapshots to get per-epoch
+// numbers. `rows` counts requested rows (duplicates included); the unique
+// rows actually fetched are `rows - dedup_hits`, so the coalesce ratio is
+// (rows - dedup_hits) / runs.
+struct PlanStats {
+  int64_t batches = 0;        // GetBatch calls planned
+  int64_t rows = 0;           // rows requested (incl. duplicates)
+  int64_t runs = 0;           // coalesced contiguous runs emitted
+  int64_t local_runs = 0;     // runs served by the local shard
+  int64_t peer_lists = 0;     // remote per-peer run lists issued (sum of
+                              // distinct remote peers over batches)
+  int64_t dedup_hits = 0;     // duplicate rows served by replication
+  int64_t scratch_runs = 0;   // runs staged through scratch (src-contiguous
+                              // but dst-scattered)
+  int64_t scratch_bytes = 0;  // bytes staged through scratch
+};
+
+class WorkerPool;
+
 // One-sided read transport. Implementations must be thread-safe: get_batch
 // issues reads to distinct peers concurrently.
 class Transport {
  public:
   virtual ~Transport() = default;
+
+  // Persistent background workers, when the transport keeps any (the TCP
+  // transport's pool). The Store borrows them to overlap its local-copy
+  // leg with the remote fan-out — submitted tasks must be flat leaves
+  // (never waited on from inside the pool). nullptr = none; callers run
+  // inline.
+  virtual WorkerPool* worker_pool() { return nullptr; }
 
   // Read `nbytes` starting at byte offset `offset` within peer `target`'s
   // local shard of variable `name`, into `dst`. Must not require any action
@@ -112,6 +141,23 @@ class Transport {
       if (rc != 0) return rc;
     }
     return 0;
+  }
+
+  // Shard-memory allocation hooks. The Store routes every owned
+  // allocation (Add with copy, Init's zero-fill) through its transport so
+  // a transport with a same-host fast path can place shards in shareable
+  // memory: the TCP transport backs them with /dev/shm files that peers
+  // mmap once and then gather from with plain memcpy — the scatter-read
+  // fast path that removes per-segment process_vm_readv overhead
+  // entirely. Default: plain malloc/free (the in-process transport needs
+  // nothing more). FreeShard must accept any pointer AllocShard returned.
+  virtual void* AllocShard(const std::string& name, int64_t nbytes) {
+    (void)name;
+    return ::malloc(nbytes > 0 ? static_cast<size_t>(nbytes) : 1);
+  }
+  virtual void FreeShard(const std::string& name, void* base) {
+    (void)name;
+    ::free(base);
   }
 
   // Variable-lifecycle hooks, called by the Store UNDER its exclusive
@@ -176,12 +222,23 @@ class Store {
   int Get(const std::string& name, void* dst, int64_t start, int64_t count);
 
   // Read n single rows with global indices starts[0..n) into dst (densely
-  // packed, n*row_bytes). Reads are coalesced per owner (adjacent runs merge
-  // into one transport read) and issued to distinct peers concurrently. This
-  // is the hot-path fix for the reference's one-blocking-read-per-sample
-  // pattern (ddstore.hpp:197-248 called per sample per batch).
+  // packed, n*row_bytes). The scatter-read planner sorts the indices,
+  // dedups duplicates (fetched once, replicated into their other output
+  // slots afterwards), and coalesces rows that are adjacent in the owner's
+  // shard into maximal contiguous runs — a run whose output slots are also
+  // contiguous reads straight into dst; otherwise it is staged through a
+  // per-call scratch block and scatter-copied out (memcpy is orders of
+  // magnitude cheaper than per-segment transport overhead). Per-peer run
+  // lists go to the transport in one ReadVMulti, offset-sorted, so the
+  // wire/iovec path sees the fewest, largest, most sequential segments the
+  // request permits. This is the hot-path fix for the reference's
+  // one-blocking-read-per-sample pattern (ddstore.hpp:197-248 called per
+  // sample per batch).
   int GetBatch(const std::string& name, void* dst, const int64_t* starts,
                int64_t n);
+
+  // Snapshot of the cumulative scatter-read planner statistics.
+  PlanStats plan_stats() const;
 
   // Metadata query: total rows across all ranks (reference `query`,
   // src/ddstore.cxx:46-49) plus shape info.
@@ -239,12 +296,14 @@ class Store {
   int ReadLocalV(const std::string& name, const ReadOp* ops,
                  int64_t n) const;
 
-  // Validate a prospective ReadLocal without touching memory. Serving
-  // threads call this BEFORE sizing their scratch buffer, so a corrupt or
-  // hostile request length is answered with an error code instead of an
-  // allocation attempt.
-  int CheckLocal(const std::string& name, int64_t offset,
-                 int64_t nbytes) const;
+  // Run `fn(base, shard_bytes)` on the LOCAL shard under the shared lock
+  // — the zero-intermediate-copy serving path: the TCP server streams
+  // response bytes straight out of shard memory inside `fn` instead of
+  // memcpying them into a scratch buffer first. `fn`'s return value is
+  // passed through; kErrNotFound if the variable is unknown. `fn` must be
+  // bounded (the lock blocks Update/Rebind/FreeVar for its duration).
+  int WithShard(const std::string& name,
+                const std::function<int(const char*, int64_t)>& fn) const;
 
  private:
   int AddInternal(const std::string& name, const void* buf, int64_t nrows,
@@ -259,6 +318,11 @@ class Store {
   bool fence_active_ = false;
   bool epoch_collective_ = true;
   int64_t epoch_tag_ = 0;
+
+  // Scatter-read planner statistics (GetBatch runs concurrently; a plain
+  // mutex is fine — one lock per batch, not per row).
+  mutable std::mutex stats_mu_;
+  PlanStats stats_;
 };
 
 }  // namespace dds

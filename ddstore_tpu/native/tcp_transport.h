@@ -94,9 +94,26 @@ class TcpTransport : public Transport {
     local_addrs_ = addrs;
   }
 
+  // Owned shards are backed by /dev/shm data files when the CMA registry
+  // is up: same-host peers mmap them once and serve batched reads with
+  // plain memcpy — the scatter-read fast path (see cma.h). malloc
+  // fallback when shm is unavailable (the shard then rides the
+  // process_vm_readv / TCP paths instead).
+  void* AllocShard(const std::string& name, int64_t nbytes) override {
+    if (cma_reg_ && nbytes > 0) {
+      uint64_t id;
+      if (void* p = cma_reg_->AllocData(nbytes, &id)) return p;
+    }
+    return ::malloc(nbytes > 0 ? static_cast<size_t>(nbytes) : 1);
+  }
+  void FreeShard(const std::string& name, void* base) override {
+    if (cma_reg_ && cma_reg_->FreeData(base)) return;
+    ::free(base);
+  }
+
   // Variable-lifecycle hooks (Store calls these under its exclusive
   // lock): publish/clear the local shard mapping in the CMA registry so
-  // same-host peers can read it with process_vm_readv (see cma.h).
+  // same-host peers can read it one-sidedly (see cma.h).
   void PublishVar(const std::string& name, const void* base,
                   int64_t nbytes) override {
     if (cma_reg_) cma_reg_->Publish(name, base, nbytes);
@@ -107,6 +124,11 @@ class TcpTransport : public Transport {
   // Ops served via the CMA fast path since construction (observability +
   // tests asserting the path actually engaged).
   int64_t cma_ops() const { return cma_ops_.load(); }
+
+  // Successful dials of the same-host Unix-domain fast lane since
+  // construction (observability: distinguishes "loopback peers rode the
+  // UDS lane" from "silently fell back to loopback TCP" in bench JSON).
+  int64_t uds_conns() const { return uds_conns_.load(); }
 
   // Adaptive routing state snapshot for one traffic class (0 = bulk,
   // 1 = scatter) — observability: exported into bench extras so routing
@@ -129,6 +151,7 @@ class TcpTransport : public Transport {
   int Barrier(int64_t tag) override;
   int rank() const override { return rank_; }
   int world() const override { return world_; }
+  WorkerPool* worker_pool() override { return &pool_; }
 
  private:
   // One TCP connection to a peer. A peer owns a small pool of these
@@ -138,6 +161,10 @@ class TcpTransport : public Transport {
   struct Conn {
     int fd = -1;
     int idx = 0;    // position in the pool; picks the NIC pairing
+    // Same-host fast lane: whether this slot already probed the peer's
+    // Unix-domain listener (probe once; a failed probe falls back to TCP
+    // permanently until UpdatePeer swaps the endpoint).
+    bool uds_tried = false;
     std::mutex mu;  // serializes use of this connection
   };
   struct Peer {
@@ -164,7 +191,7 @@ class TcpTransport : public Transport {
   // The pipelined request/response loop over one connection.
   int ReadVOn(Peer& p, Conn& c, const std::string& name, const ReadOp* ops,
               int64_t n);
-  void AcceptLoop();
+  void AcceptLoop(int lfd, bool is_tcp);
   void HandleConnection(int fd);
   // Send one one-way barrier notify for (tag, round) to `target`.
   bool SendBarrierNotify(int target, int64_t tag, int round);
@@ -177,6 +204,17 @@ class TcpTransport : public Transport {
   int listen_fd_ = -1;
   int server_port_ = -1;
   std::thread accept_thread_;
+  // Same-host fast lane: a second listener on an abstract-namespace
+  // Unix-domain socket named after the TCP port (which is unique per
+  // network namespace, so the name cannot collide between instances).
+  // Loopback-addressed peers dial it instead of TCP — same framing
+  // protocol, same serving loop, but the stream skips the (emulated)
+  // TCP/IP stack entirely: on the sandboxed 2-core bench kernel that is
+  // a measured ~1.6x per-byte saving, which is exactly the scatter
+  // class's bottleneck (it is CPU-bound on copies, not latency-bound).
+  int uds_listen_fd_ = -1;
+  std::thread uds_accept_thread_;
+  std::atomic<int64_t> uds_conns_{0};  // UDS dials that succeeded
   std::mutex conns_mu_;
   std::vector<std::thread> conn_threads_;
   std::vector<int> conn_fds_;
@@ -217,6 +255,20 @@ class TcpTransport : public Transport {
     int64_t crossovers = 0;  // preference flips (observability: a
     //                          flapping policy shows up as a count,
     //                          diagnosable from BENCH json alone)
+    int cma_n = 0;   // clean samples folded into each EWMA: the router
+    int tcp_n = 0;   // keeps collecting until both reach kMinRouteSamples
+    int cold_skips = 0;  // connect-tainted seeds discarded (bounded)
+    // Probes run as consecutive PAIRS on the non-preferred path: the
+    // first window re-warms it (idle TCP connections restart from
+    // slow-start, pool threads sleep) and its sample is discarded; only
+    // the second, warm window is folded into the EWMA. Set when the
+    // warm-up window is dispatched; cleared by RecordRouteSample.
+    bool discard_probe = false;
+    // Collection applies the same rule: each path's very first window is
+    // a warm-up whose sample is discarded, so the seed estimates are
+    // built from warm windows only.
+    bool cma_warmed = false;
+    bool tcp_warmed = false;
     bool via_tcp = false;
   };
   RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK"};
@@ -231,9 +283,18 @@ class TcpTransport : public Transport {
   bool RouteBulkViaTcp() { return RouteViaTcp(bulk_route_); }
   bool RouteScatterViaTcp() { return RouteViaTcp(scatter_route_); }
   // Fold a measured (bytes, seconds) sample into one path's EWMA and
-  // re-evaluate the preference, logging any crossover.
+  // re-evaluate the preference, logging any crossover. ``cold`` marks a
+  // window that included connection setup: such a sample measures the
+  // dial, not the transport, and must not SEED a path's estimate (a
+  // routing verdict parked on it would take many probe windows to
+  // overturn).
   void RecordRouteSample(RouteClass& rc, bool via_tcp, int64_t bytes,
-                         double secs);
+                         double secs, bool cold = false);
+
+  // Connections dialed so far (EnsureConnected establishing a fresh
+  // socket). The TCP read leg snapshots it around its timed window to
+  // detect connect-tainted routing samples.
+  std::atomic<int64_t> dials_{0};
 
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,

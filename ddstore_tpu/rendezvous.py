@@ -187,6 +187,11 @@ class FileGroup(ProcessGroup):
         if launch_id is None:
             launch_id = os.environ.get("DDSTORE_RDV_ID")
         self._launch = launch_id
+        # ONE join budget for the whole constructor: the marker wait and
+        # the hello phase share this deadline, so a non-zero rank's join
+        # is bounded by `timeout` — not ~2x it (marker read consuming a
+        # full budget, then the hello loop starting a fresh one).
+        deadline = time.time() + timeout
         marker = os.path.join(root, "MARKER")
         if rank == 0:
             for f in os.listdir(root):
@@ -201,7 +206,7 @@ class FileGroup(ProcessGroup):
                 fh.write(self._run)
             os.replace(tmp, marker)
         else:
-            self._run = self._read_marker(marker, time.time() + timeout)
+            self._run = self._read_marker(marker, deadline)
         # Hello phase with a liveness proof. Every rank publishes
         # {run}.hello.{rank} holding its instance nonce; rank 0 collects
         # the full set and answers with {run}.roster listing the nonces
@@ -213,7 +218,6 @@ class FileGroup(ProcessGroup):
         # fresh process's nonce, so late rank-0 arrival just makes the
         # others wait, re-reading the marker (and re-publishing their
         # hellos) until the fresh generation acknowledges them.
-        deadline = time.time() + timeout
         written_for = last_run = None
         conflict = False
         spins = 0
@@ -335,16 +339,29 @@ class FileGroup(ProcessGroup):
     def _publish(self, seq: int, obj: Any) -> None:
         path = os.path.join(self.root, f"{self._run}.{seq}.{self.rank}.pkl")
         tmp = f"{path}.{self._me}.tmp"
-        try:
-            with open(tmp, "wb") as f:
-                pickle.dump(obj, f)
-            os.replace(tmp, path)  # atomic publish
-        except OSError:
-            # A newer launch's wipe can unlink the staging file between
-            # write and replace; diagnose that instead of surfacing a
-            # bare FileNotFoundError.
-            self._raise_if_stale(f"publish {seq}")
-            raise
+        for attempt in (0, 1):
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(obj, f)
+                os.replace(tmp, path)  # atomic publish
+                return
+            except OSError:
+                # A newer launch's wipe can unlink the staging file
+                # between write and replace; diagnose that instead of
+                # surfacing a bare FileNotFoundError.
+                self._raise_if_stale(f"publish {seq}")
+                if self._current_run() == self._run:
+                    raise  # real I/O failure (ENOSPC, EACCES, ...)
+                # Marker MISSING (mid-wipe window: rank 0 of a new launch
+                # deleted it, its replacement imminent): retry once —
+                # a transient unrelated unlink resolves — then diagnose
+                # the takeover rather than leak a bare FileNotFoundError.
+                if attempt:
+                    raise TimeoutError(
+                        f"FileGroup publish {seq}: rendezvous generation "
+                        f"changed under a live run — this rank is stale "
+                        f"(a new world is launching in {self.root})")
+                time.sleep(0.005)
 
     def _current_run(self) -> Optional[str]:
         try:

@@ -505,8 +505,18 @@ class DDStore:
 
     @property
     def cma_ops(self) -> int:
-        """Ops served by the same-host CMA (process_vm_readv) fast path."""
+        """Ops served by the same-host CMA fast path (shared-memory
+        mapped gather, or process_vm_readv for borrowed shards)."""
         return self._native.cma_ops
+
+    def plan_stats(self) -> dict:
+        """Cumulative scatter-read planner statistics (:meth:`get_batch`):
+        batches/rows planned, coalesced runs, per-peer run lists, dedup
+        hits, scratch staging, plus the derived ``plan_coalesce_ratio``
+        and ``plan_runs_per_peer_list``. Counters are monotone since store
+        creation; diff two snapshots for a per-epoch view (that is what
+        ``DeviceLoader.metrics`` reports)."""
+        return self._native.plan_stats()
 
     @property
     def rank(self) -> int:

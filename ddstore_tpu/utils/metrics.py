@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 
 class LatencyHistogram:
@@ -71,24 +71,76 @@ class _Timer:
         self.hist.record(time.perf_counter() - self.t0)
 
 
+def plan_stats_delta(begin: Dict, end: Dict) -> Dict:
+    """Per-window scatter-planner statistics from two cumulative
+    ``plan_stats()`` snapshots (the counters are monotone since store
+    creation). The derived ratios are recomputed from the deltas — NOT
+    diffed — so a window's coalesce ratio describes that window's
+    batches, not the whole store lifetime:
+
+    * ``plan_coalesce_ratio`` — unique rows fetched per transport run
+      (1.0 = nothing coalesced; higher = fewer, larger segments).
+    * ``plan_runs_per_peer_list`` — remote runs per per-peer request
+      issued (the fan-out each transport call carries).
+    """
+    out = {}
+    for k in ("plan_batches", "plan_rows", "plan_runs", "plan_local_runs",
+              "plan_peer_lists", "plan_dedup_hits", "plan_scratch_runs",
+              "plan_scratch_bytes"):
+        out[k] = int(end.get(k, 0)) - int(begin.get(k, 0))
+    uniq = out["plan_rows"] - out["plan_dedup_hits"]
+    out["plan_coalesce_ratio"] = \
+        uniq / out["plan_runs"] if out["plan_runs"] else 0.0
+    out["plan_runs_per_peer_list"] = \
+        (out["plan_runs"] - out["plan_local_runs"]) / out["plan_peer_lists"] \
+        if out["plan_peer_lists"] else 0.0
+    return out
+
+
 class PipelineMetrics:
     """Input-pipeline efficiency: fraction of wall-clock the device did NOT
     wait on data. The loader records how long each ``__next__`` blocked
     (`wait`); the training loop's total span is everything else (compute +
-    dispatch). efficiency = 1 - wait/total."""
+    dispatch). efficiency = 1 - wait/total.
 
-    def __init__(self):
+    With a plan source attached (``set_plan_source`` — the loader wires
+    its dataset's ``DDStore.plan_stats`` automatically), the summary also
+    carries the epoch's scatter-read planner statistics: how well the
+    fetch path coalesced/deduped this epoch's batches."""
+
+    def __init__(self, plan_source: Optional[Callable[[], Dict]] = None):
         self.wait = LatencyHistogram("device_wait")
         self.fetch = LatencyHistogram("host_fetch")
         self.stage = LatencyHistogram("device_put")
         self._t_start: Optional[float] = None
         self._t_end: Optional[float] = None
+        self._plan_source = plan_source
+        self._plan_begin: Optional[Dict] = None
+        self._plan_end: Optional[Dict] = None
+
+    def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning cumulative planner
+        counters (``DDStore.plan_stats``). Snapshotted at epoch
+        boundaries; ``summary()`` reports the per-epoch delta."""
+        self._plan_source = source
+
+    def _snap_plan(self) -> Optional[Dict]:
+        if self._plan_source is None:
+            return None
+        try:
+            return dict(self._plan_source())
+        except Exception:
+            # A closed/torn-down store must not sink epoch accounting.
+            return None
 
     def epoch_start(self) -> None:
         self._t_start = time.perf_counter()
+        self._plan_begin = self._snap_plan()
+        self._plan_end = None
 
     def epoch_end(self) -> None:
         self._t_end = time.perf_counter()
+        self._plan_end = self._snap_plan()
 
     @property
     def total_s(self) -> float:
@@ -105,10 +157,17 @@ class PipelineMetrics:
         return max(0.0, 1.0 - self.wait.total / total)
 
     def summary(self) -> Dict:
-        return {
+        out = {
             "input_pipeline_efficiency": self.efficiency,
             "total_s": self.total_s,
             "device_wait": self.wait.summary(),
             "host_fetch": self.fetch.summary(),
             "device_put": self.stage.summary(),
         }
+        if self._plan_begin is not None:
+            # Mid-epoch summary: diff against the live counters.
+            end = self._plan_end if self._plan_end is not None \
+                else self._snap_plan()
+            if end is not None:
+                out["scatter_plan"] = plan_stats_delta(self._plan_begin, end)
+        return out

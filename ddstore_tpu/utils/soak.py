@@ -35,16 +35,25 @@ def _sentinel(r: int) -> np.ndarray:
 
 
 def mmap_soak(rows: int = 100_000_000, batch: int = 65536,
-              nbatches: int = 64, directory: Optional[str] = None) -> dict:
+              nbatches: int = 64, directory: Optional[str] = None,
+              budget_s: Optional[float] = None) -> dict:
     """Run the soak; returns a dict of measurements:
 
     * ``rows`` / ``rows_sampled`` — shard size and rows actually fetched
     * ``rows_per_s`` — batched-get throughput of the sampled epoch
+    * ``batches_run`` — batches completed (< ``nbatches`` when
+      ``budget_s`` cut the epoch short; throughput stays valid — it is
+      rows-fetched over time-spent either way)
     * ``rss_add_delta_mb`` — RSS growth across ``add_mmap`` (must be
       ~0: registration must not copy the shard)
     * ``rss_delta_mb`` — RSS growth across the whole soak (bounded by
       pages touched, at most the file size — not by row count)
     * ``sentinels_ok`` — far-offset reads returned the stamped bytes
+
+    ``budget_s`` bounds the SAMPLED-EPOCH wall time: on a slow box
+    (cold page cache, sandboxed I/O) the fixed iteration count can
+    outlive a caller's harness timeout, and a killed soak reports
+    nothing; a budget-truncated one reports everything it measured.
     """
     from .. import DDStore
     from ..data import DistributedSampler
@@ -70,14 +79,19 @@ def mmap_soak(rows: int = 100_000_000, batch: int = 65536,
             sampler = DistributedSampler(rows, world=1, rank=0, seed=7,
                                          mode="streamed")
             t0 = time.perf_counter()
-            n = 0
+            n = nb = 0
             for b in itertools.islice(sampler.batches(batch), nbatches):
                 out = s.get_batch("edges", b)
                 assert out.shape == (len(b), 2)
                 n += len(b)
+                nb += 1
+                if budget_s is not None \
+                        and time.perf_counter() - t0 > budget_s:
+                    break
             dt = time.perf_counter() - t0
             return {"rows": rows, "rows_sampled": n,
                     "rows_per_s": n / dt,
+                    "batches_run": nb,
                     "rss_add_delta_mb": rss_add,
                     "rss_delta_mb": _vm_rss_mb() - rss0,
                     "sentinels_ok": ok}

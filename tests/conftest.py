@@ -26,6 +26,14 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Tier-1 exercises the native core throughout: (re)build it up front when
+# any native/*.cc|*.h is newer than the cached _lib/*.so (`make native`
+# runs the same stale-aware entry). One clean compile here beats N test
+# processes racing the lazy first-import build.
+from ddstore_tpu import _build  # noqa: E402
+
+_build.build()
+
 
 @pytest.fixture
 def rng():

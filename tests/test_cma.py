@@ -229,14 +229,15 @@ def test_bulk_routing_forced_cma(tmp_path):
 @pytest.mark.skipif(not _cma_possible(),
                     reason="yama ptrace_scope >= 2 forbids CMA")
 def test_bulk_routing_adaptive_samples_both(tmp_path):
-    """Default (adaptive) routing: the first bulk read samples CMA, the
-    second samples TCP, then the measured-faster path serves the rest.
-    Only the first two are deterministic; the steady-state choice is
+    """Default (adaptive) routing: each path gets a consecutive run of
+    collection windows — one discarded warm-up plus two recorded samples,
+    CMA first, then TCP — after which the measured-faster path serves the
+    rest. Only that prefix is deterministic; the steady-state choice is
     whatever this box measures faster (that's the point)."""
     info = _spawn(2, _worker_routing, str(tmp_path), (None,))
-    assert info[0][0] is True, info[0]   # sample CMA
-    assert info[0][1] is False, info[0]  # sample TCP
-    assert info[0][4] is True, info[0]   # small get -> CMA always
+    assert info[0][:3] == [True] * 3, info[0]  # CMA warm-up + 2 samples
+    assert info[0][3] is False, info[0]        # first TCP window
+    assert info[0][4] is True, info[0]         # small get -> CMA always
 
 
 def _worker_routing_soak(rank, world, tmp, q):
@@ -325,14 +326,15 @@ def test_scatter_routing_forced(tmp_path):
 @pytest.mark.skipif(not _cma_possible(),
                     reason="yama ptrace_scope >= 2 forbids CMA")
 def test_scatter_routing_adaptive_stable(tmp_path):
-    """Adaptive scatter routing: first batch samples CMA, second samples
-    TCP, then the measured-faster path serves the rest without flapping
-    (same EWMA/probe/hysteresis policy as the bulk class, separate
+    """Adaptive scatter routing: collection runs each path consecutively
+    (warm-up + 2 recorded samples, CMA first, then TCP), then the
+    measured-faster path serves the rest without flapping (same
+    EWMA/probe/hysteresis policy as the bulk class, separate
     estimates)."""
     info = _spawn(2, _worker_scatter_routing, str(tmp_path), (None,))
     trace, st = info[0]
-    assert trace[0] is True, trace    # sample CMA
-    assert trace[1] is False, trace   # sample TCP
+    assert trace[:3] == [True] * 3, trace  # CMA warm-up + 2 samples
+    assert trace[3] is False, trace        # first TCP window
     assert st["scatter_decisions"] >= 20, st
     assert st["cma_scatter_gbps"] > 0 and st["tcp_scatter_gbps"] > 0, st
     assert st["scatter_crossovers"] <= 2, st

@@ -1,0 +1,242 @@
+"""Scatter-read engine tests: the GetBatch planner (sort, adjacent-row
+coalescing, duplicate-index dedup with post-fetch replication), IOV_MAX
+chunking on every transport path, and end-to-end equivalence — a batched
+read must be byte-identical to the per-row path no matter how the planner
+reorders, merges, or stages the fetches."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStore, SingleGroup, ThreadGroup
+from ddstore_tpu.utils.metrics import plan_stats_delta
+
+
+def _rows(num, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((num, dim)).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Planner unit tests (single process; the plan is transport-agnostic).
+# ---------------------------------------------------------------------------
+
+
+def test_plan_coalesces_shuffled_contiguous_range():
+    data = _rows(512, 8)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.add("v", data)
+        before = s.plan_stats()
+        idx = np.random.default_rng(1).permutation(512)
+        got = s.get_batch("v", idx)
+        d = plan_stats_delta(before, s.plan_stats())
+    np.testing.assert_array_equal(got, data[idx])
+    # A permutation of a full contiguous range sorts back into ONE run.
+    assert d["plan_batches"] == 1
+    assert d["plan_rows"] == 512
+    assert d["plan_runs"] == 1
+    assert d["plan_local_runs"] == 1
+    assert d["plan_coalesce_ratio"] == 512.0
+
+
+def test_plan_strided_rows_do_not_coalesce():
+    data = _rows(256, 4)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.add("v", data)
+        before = s.plan_stats()
+        idx = np.arange(0, 256, 2)  # stride 2: nothing adjacent
+        got = s.get_batch("v", idx)
+        d = plan_stats_delta(before, s.plan_stats())
+    np.testing.assert_array_equal(got, data[idx])
+    assert d["plan_runs"] == len(idx)
+    assert d["plan_coalesce_ratio"] == 1.0
+    assert d["plan_dedup_hits"] == 0
+
+
+def test_plan_dedups_duplicate_indices():
+    data = _rows(64, 8)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.add("v", data)
+        before = s.plan_stats()
+        # 5 distinct rows, each requested 4 times, shuffled.
+        idx = np.random.default_rng(2).permutation(
+            np.repeat([3, 17, 17 + 1, 40, 63], 4))
+        got = s.get_batch("v", idx)
+        d = plan_stats_delta(before, s.plan_stats())
+    np.testing.assert_array_equal(got, data[idx])
+    assert d["plan_rows"] == 20
+    assert d["plan_dedup_hits"] == 15  # 20 requested - 5 unique
+    # Unique rows 3,17,18,40,63 coalesce into 4 runs (17,18 merge).
+    assert d["plan_runs"] == 4
+
+
+def test_plan_scratch_path_scattered_outputs():
+    """Source-contiguous but destination-scattered runs stage through
+    scratch: request a contiguous range in REVERSED order — one run,
+    but output slots are non-contiguous."""
+    data = _rows(128, 8)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.add("v", data)
+        before = s.plan_stats()
+        idx = np.arange(127, -1, -1)
+        got = s.get_batch("v", idx)
+        d = plan_stats_delta(before, s.plan_stats())
+    np.testing.assert_array_equal(got, data[idx])
+    assert d["plan_runs"] == 1
+    assert d["plan_scratch_runs"] == 1
+    assert d["plan_scratch_bytes"] == 128 * 8 * 8
+
+
+def test_plan_stats_delta_recomputes_ratios():
+    a = {"plan_batches": 1, "plan_rows": 100, "plan_runs": 10,
+         "plan_local_runs": 2, "plan_peer_lists": 2, "plan_dedup_hits": 0,
+         "plan_scratch_runs": 0, "plan_scratch_bytes": 0}
+    b = {"plan_batches": 2, "plan_rows": 300, "plan_runs": 30,
+         "plan_local_runs": 6, "plan_peer_lists": 6, "plan_dedup_hits": 40,
+         "plan_scratch_runs": 1, "plan_scratch_bytes": 128}
+    d = plan_stats_delta(a, b)
+    assert d["plan_rows"] == 200 and d["plan_runs"] == 20
+    assert d["plan_coalesce_ratio"] == (200 - 40) / 20
+    assert d["plan_runs_per_peer_list"] == (20 - 4) / 4
+
+
+def test_plan_empty_and_error_batches():
+    data = _rows(16, 4)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.add("v", data)
+        got = s.get_batch("v", np.empty((0,), np.int64))
+        assert got.shape == (0, 4)
+        with pytest.raises(Exception):
+            s.get_batch("v", np.asarray([0, 16]))  # out of range
+
+
+# ---------------------------------------------------------------------------
+# End-to-end equivalence, local (in-process) backend, multi-rank.
+# ---------------------------------------------------------------------------
+
+
+def test_get_batch_equals_per_row_local_threadgroup():
+    import threading
+    import uuid
+
+    world, num, dim = 4, 96, 8
+    name = uuid.uuid4().hex
+    failures = []
+
+    def body(rank):
+        try:
+            g = ThreadGroup(name, rank, world)
+            with DDStore(g, backend="local") as s:
+                s.add("v", _rows(num, dim, seed=rank))
+                if rank == 0:
+                    rng = np.random.default_rng(7)
+                    # Permuted global indices WITH repeats, all peers hit.
+                    idx = rng.integers(0, world * num, size=1024)
+                    batch = s.get_batch("v", idx)
+                    for i, gi in enumerate(idx):
+                        np.testing.assert_array_equal(
+                            batch[i], s.get("v", int(gi))[0])
+                s.barrier()
+        except BaseException:  # noqa: BLE001
+            import traceback
+            failures.append(traceback.format_exc())
+
+    ts = [threading.Thread(target=body, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    assert not failures, failures[0]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end equivalence + IOV_MAX chunking over the TCP transport.
+# Three path variants: pure TCP frames, CMA shm-mapped gather (owned
+# shards), CMA process_vm_readv (borrowed shards can't live in shm).
+# ---------------------------------------------------------------------------
+
+NUM, DIM = 4096, 8
+
+
+def _tcp_equiv_worker(rank, world, tmp, q, copy):
+    try:
+        from ddstore_tpu import DDStore, FileGroup
+
+        group = FileGroup(os.path.join(tmp, "rdv"), rank, world)
+        with DDStore(group, backend="tcp", copy=copy) as s:
+            shard = _rows(NUM, DIM, seed=rank)
+            s.add("v", shard)
+            if rank == 0:
+                rng = np.random.default_rng(3)
+                # >1024 non-adjacent rows from ONE peer: the per-peer run
+                # list exceeds Linux IOV_MAX, exercising the chunk walk in
+                # whichever path serves it (sendmsg/recvmsg chunks, the
+                # pvm iovec chunks, or the shm memcpy gather).
+                idx = NUM + np.arange(0, 3000, 2)[:1500]  # peer 1's shard
+                got = s.get_batch("v", idx)
+                want = np.stack([s.get("v", int(i))[0] for i in idx])
+                np.testing.assert_array_equal(got, want)
+
+                # Random permuted indices with repeats across ALL peers.
+                idx2 = rng.integers(0, world * NUM, size=4096)
+                got2 = s.get_batch("v", idx2)
+                # Per-row oracle, but only over the unique set (speed);
+                # replication correctness is covered by comparing every
+                # output slot against its row's oracle value.
+                oracle = {int(i): s.get("v", int(i))[0]
+                          for i in np.unique(idx2)}
+                for i, gi in enumerate(idx2):
+                    np.testing.assert_array_equal(got2[i], oracle[int(gi)])
+
+                d = plan_stats_delta({}, s.plan_stats())
+                assert d["plan_rows"] >= 1500 + 4096
+                assert d["plan_dedup_hits"] > 0  # 4096 draws from 16384
+            s.barrier()
+        q.put((rank, None))
+    except BaseException:  # noqa: BLE001
+        import traceback
+        q.put((rank, traceback.format_exc()))
+
+
+def _spawn_tcp(world, tmp, env, copy):
+    backup = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_tcp_equiv_worker,
+                             args=(r, world, tmp, q, copy))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        try:
+            for _ in range(world):
+                r, err = q.get(timeout=300)
+                results[r] = err
+        finally:
+            for p in procs:
+                p.join(timeout=30)
+                if p.is_alive():
+                    p.terminate()
+        errs = {r: e for r, e in results.items() if e}
+        assert not errs, f"worker failures: {errs}"
+    finally:
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize("env,copy", [
+    ({"DDSTORE_CMA": "0"}, True),                              # TCP frames
+    ({"DDSTORE_CMA": "1", "DDSTORE_CMA_SCATTER": "1",
+      "DDSTORE_CMA_BULK": "1"}, True),                         # shm gather
+    ({"DDSTORE_CMA": "1", "DDSTORE_CMA_SCATTER": "1",
+      "DDSTORE_CMA_BULK": "1"}, False),                        # pvm iovecs
+], ids=["tcp", "cma-shm", "cma-pvm"])
+def test_get_batch_equals_per_row_tcp(tmp_path, env, copy):
+    _spawn_tcp(2, str(tmp_path), env, copy)
