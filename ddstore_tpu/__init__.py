@@ -21,6 +21,7 @@ Layers (bottom-up):
 * ``utils/`` — metrics and logging.
 """
 
+from . import _compat  # noqa: F401  — jax API aliases for older runtimes
 from .binding import DDStoreError, NativeStore, owner_of
 from .elastic import recover as elastic_recover
 from .elastic import rejoin as elastic_rejoin

@@ -52,7 +52,7 @@ def _load() -> ctypes.CDLL:
     lib.dds_routing_state.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_double), _i64p, _i64p,
-        ctypes.POINTER(ctypes.c_int)]
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
     lib.dds_set_barrier_seq.restype = ctypes.c_int
     lib.dds_set_barrier_seq.argtypes = [ctypes.c_void_p, _i64]
     lib.dds_add.restype = ctypes.c_int
@@ -199,15 +199,18 @@ class NativeStore:
             dec = ctypes.c_int64()
             cro = ctypes.c_int64()
             via = ctypes.c_int()
+            cal = ctypes.c_int()
             _check(self._lib.dds_routing_state(
                 self._h, cls, ctypes.byref(cma), ctypes.byref(tcp),
-                ctypes.byref(dec), ctypes.byref(cro), ctypes.byref(via)),
+                ctypes.byref(dec), ctypes.byref(cro), ctypes.byref(via),
+                ctypes.byref(cal)),
                 "routing_state")
             out.update({f"cma_{label}_gbps": cma.value / 1e9,
                         f"tcp_{label}_gbps": tcp.value / 1e9,
                         f"{label}_decisions": dec.value,
                         f"{label}_crossovers": cro.value,
-                        f"{label}_via_tcp": bool(via.value)})
+                        f"{label}_via_tcp": bool(via.value),
+                        f"{label}_calibrated": bool(cal.value)})
         # Same-host Unix-lane dials: whether loopback peers actually took
         # the UDS fast lane or silently fell back to loopback TCP.
         out["uds_conns"] = self._lib.dds_uds_conns(self._h)

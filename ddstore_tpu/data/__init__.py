@@ -1,6 +1,8 @@
 """Dataset adapters and device-feeding loaders over the store."""
 
 from .dataset import DistributedSampler, ShardedDataset, nsplit
+from .device_fetch import (device_fetch_batch, device_fetch_ragged_batch,
+                           host_bytes_over_dcn, plan_device_fetch)
 from .permute import FeistelPermutation
 from .formats import (find_mnist, load_mnist, load_qm9_dir,
                       molecule_to_graph, read_idx, read_xyz,
@@ -13,6 +15,8 @@ from .ragged import (pack_ragged, pad_ragged, segment_ids_from_lengths,
 
 __all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader", "nsplit",
            "FeistelPermutation",
+           "plan_device_fetch", "device_fetch_batch",
+           "device_fetch_ragged_batch", "host_bytes_over_dcn",
            "pad_ragged", "pack_ragged", "split_ragged",
            "segment_ids_from_lengths", "GraphBatch", "GraphSample",
            "GraphShardedDataset", "pack_graph_batch", "synthetic_graphs",

@@ -66,6 +66,18 @@ class ShardedDataset:
     def __len__(self) -> int:
         return self._total
 
+    @property
+    def data_var(self) -> str:
+        """Store variable holding the samples — the handle
+        :class:`~ddstore_tpu.data.loader.DeviceLoader` uses for the
+        device-collective fetch path (``device_collective=True``)."""
+        return self._data_var
+
+    @property
+    def label_var(self) -> Optional[str]:
+        """Co-variable holding the labels (None when label-free)."""
+        return self._label_var
+
     def __getitem__(self, idx: int):
         x = self.store.get(self._data_var, int(idx))[0]
         if self._label_var is None:

@@ -37,6 +37,16 @@ except Exception:  # pragma: no cover
     jax = None
 
 
+class _PendingExchange:
+    """A staged collective fetch whose device exchange still needs the
+    consumer thread (single-thread collective dispatch discipline)."""
+
+    __slots__ = ("finalize",)
+
+    def __init__(self, finalize: Callable):
+        self.finalize = finalize
+
+
 class DeviceLoader:
     """Iterate device-ready (sharded) batches from a store-backed dataset.
 
@@ -64,6 +74,14 @@ class DeviceLoader:
         at that concurrency.
     drop_last: drop the trailing partial batch (keeps shapes static for
         jit — recompile-free epochs).
+    device_collective: stage batches with the device-collective fetch
+        (``data/device_fetch.py``): one purely local ``get_batch`` per
+        host + an on-device ``all_to_all`` over ICI delivers every row
+        to its destination DP shard — remote rows never cross DCN and
+        the batch is device_put exactly once. Requires a mesh, the
+        default ``P(axis)`` spec, no host transform, and a store-backed
+        dataset exposing ``data_var``; anything else falls back to the
+        host path with the reason in ``collective_fallback_reason``.
     transform: optional host-side function applied to each fetched batch.
         With workers > 1 the transform is serialized under a lock (fetch
         and staging still run in parallel), so stateful transforms — e.g.
@@ -81,7 +99,8 @@ class DeviceLoader:
                  transform: Optional[Callable] = None,
                  spec: Optional["PartitionSpec"] = None,
                  workers: Optional[int] = None,
-                 transform_thread_safe: bool = False):
+                 transform_thread_safe: bool = False,
+                 device_collective: bool = False):
         self.dataset = dataset
         self.sampler = sampler
         self.batch_size = int(batch_size)
@@ -121,6 +140,104 @@ class DeviceLoader:
             spec = PartitionSpec(axis)
         self._sharding = (NamedSharding(mesh, spec)
                          if mesh is not None else None)
+        # Device-collective staging (`device_collective=True`): each
+        # host reads only the rows it OWNS (one purely local get_batch),
+        # stages them sharded, and an on-device all_to_all over ICI
+        # delivers every row to its destination DP shard — the permuted
+        # batch never rides DCN or the double host->device bounce. Falls
+        # back to the host path automatically when the prerequisites
+        # don't hold (no mesh, custom spec/transform, a dataset without
+        # store+data_var, or a batch geometry the planner rejects);
+        # `collective_fallback_reason` records why.
+        self.device_collective = bool(device_collective)
+        self.collective_fallback_reason: Optional[str] = None
+        self._collective_ready = False
+        if self.device_collective:
+            self._collective_ready = self._collective_usable(
+                dataset, mesh, axis, spec, transform)
+
+    def _collective_usable(self, dataset, mesh, axis, spec,
+                           transform) -> bool:
+        reason = None
+        store = getattr(dataset, "store", None)
+        if mesh is None or jax is None:
+            reason = "no mesh/ICI available"
+        elif spec != PartitionSpec(axis):
+            reason = f"custom spec {spec} (exchange delivers P({axis!r}))"
+        elif transform is not None:
+            reason = "host-side transform set"
+        elif store is None or getattr(dataset, "data_var", None) is None:
+            reason = "dataset exposes no store/data_var"
+        elif axis not in mesh.shape:
+            reason = f"mesh has no {axis!r} axis"
+        elif jax.process_count() > 1:
+            # Single-controller only for now: multi-process staging
+            # (per-host local slices) is not yet wired — see
+            # device_fetch.exchange_staged.
+            reason = "multi-process mesh (single-controller only)"
+        else:
+            d = int(mesh.shape[axis])
+            if self.batch_size % d:
+                reason = (f"batch {self.batch_size} not divisible by "
+                          f"{d} shards")
+            elif d % store.world:
+                reason = (f"{d} shards not divisible by store world "
+                          f"{store.world}")
+        if reason is not None:
+            self.collective_fallback_reason = reason
+            return False
+        return True
+
+    def _record_host_dcn(self, idx: np.ndarray) -> None:
+        """Host-path side of the bytes-moved ledger: rows owned by other
+        ranks ride the DCN transport (plus labels when present)."""
+        from .device_fetch import host_bytes_over_dcn
+
+        store = getattr(self.dataset, "store", None)
+        data_var = getattr(self.dataset, "data_var", None)
+        if store is None or data_var is None:
+            return
+        dcn = host_bytes_over_dcn(store, data_var, idx)
+        label_var = getattr(self.dataset, "label_var", None)
+        if label_var is not None:
+            dcn += host_bytes_over_dcn(store, label_var, idx)
+        self.metrics.add_bytes(bytes_over_dcn=dcn)
+
+    def _fetch_collective(self, idx: np.ndarray):
+        """Host half of the collective staging, on a WORKER thread:
+        plan + local reads + send-buffer fill. Returns a thunk the
+        consumer thread runs to dispatch the exchange — collective
+        program launches from concurrent threads interleave across the
+        per-device executors and deadlock (see
+        ``device_fetch.StagedFetch``), so the exchange must ride the
+        same thread as the train step. Raises ValueError for geometries
+        the planner rejects (caller falls back per batch)."""
+        from .device_fetch import (exchange_staged, plan_device_fetch,
+                                   stage_batch)
+
+        store = self.dataset.store
+        data_var = self.dataset.data_var
+        d = int(self.mesh.shape[self.axis])
+        with self.metrics.fetch.timed(), annotate("ddstore:device_fetch"):
+            plan = plan_device_fetch(store.row_starts(data_var), idx, d)
+            staged = [stage_batch(store, data_var, idx, d, plan=plan,
+                                  metrics=self.metrics)]
+            label_var = getattr(self.dataset, "label_var", None)
+            if label_var is not None:
+                # Labels share the plan: same indices, same shard split
+                # (ShardedDataset registers both with one nsplit).
+                staged.append(stage_batch(store, label_var, idx, d,
+                                          plan=plan,
+                                          metrics=self.metrics))
+
+        def finalize():
+            with self.metrics.stage.timed(), \
+                    annotate("ddstore:device_exchange"):
+                out = [exchange_staged(sf, self.mesh, self.axis)
+                       for sf in staged]
+            return out[0] if len(out) == 1 else tuple(out)
+
+        return _PendingExchange(finalize)
 
     # -- internals ---------------------------------------------------------
 
@@ -135,9 +252,18 @@ class DeviceLoader:
             yield np.asarray(idx, dtype=np.int64)
 
     def _fetch(self, idx: np.ndarray):
+        if self._collective_ready:
+            try:
+                return self._fetch_collective(idx)
+            except ValueError:
+                # A geometry this batch can't satisfy (e.g. a short
+                # trailing batch with drop_last=False): host path for
+                # this batch only.
+                pass
         with self.metrics.fetch.timed(), annotate("ddstore:fetch"):
             batch = (self.dataset(idx) if callable(self.dataset)
                      else self.dataset.fetch(idx))
+            self._record_host_dcn(idx)
         if self.transform is not None:
             if self._transform_lock is not None:
                 with self._transform_lock:
@@ -170,6 +296,11 @@ class DeviceLoader:
             while futs:
                 t0 = time.perf_counter()
                 item = futs.popleft().result()
+                if isinstance(item, _PendingExchange):
+                    # Collective dispatch happens HERE, on the consumer
+                    # thread — the only thread launching collective
+                    # programs (the train step is its other client).
+                    item = item.finalize()
                 self.metrics.wait.record(time.perf_counter() - t0)
                 nxt = next(it, None)
                 if nxt is not None:

@@ -84,8 +84,20 @@ def _commit_generation(root: str, gen: int) -> None:
 
 
 def _vars_meta(store: DDStore) -> dict:
-    return {name: (m.dtype.str, list(m.sample_shape), list(m.all_nrows))
+    """Variable registry a survivor publishes: identity fields first
+    (dtype/shape/row counts — every rank must agree on these) plus the
+    tiering state (``readonly`` == the shard is served from a read-only
+    mmap). Tiering is EXCLUDED from the agreement check: a survivor may
+    have spilled a variable after the victim's last checkpoint, which
+    changes where its bytes live but not what they are."""
+    return {name: (m.dtype.str, list(m.sample_shape), list(m.all_nrows),
+                   bool(m.readonly))
             for name, m in store._meta.items()}
+
+
+def _identity(meta: dict) -> dict:
+    """The agreement-checked subset of :func:`_vars_meta`."""
+    return {name: tuple(v[:3]) for name, v in meta.items()}
 
 
 def _sync_state(store: DDStore, group, *, joiner: bool,
@@ -113,11 +125,11 @@ def _sync_state(store: DDStore, group, *, joiner: bool,
                                "variable metadata to rebuild from")
     ref = metas[0]
     for other in metas[1:]:
-        if other != ref:
+        if _identity(other) != _identity(ref):
             raise DDStoreError(-9, "elastic recovery: survivors disagree "
                                    "on variable metadata")
     if not joiner:
-        if _vars_meta(store) != ref:
+        if _identity(_vars_meta(store)) != _identity(ref):
             raise DDStoreError(-9, "elastic recovery: this rank's variable "
                                    "registry diverged from the group's")
         return joiners
@@ -126,7 +138,14 @@ def _sync_state(store: DDStore, group, *, joiner: bool,
     from .utils.checkpoint import _stem
 
     for name in sorted(ref):
-        dt, sshape, all_nrows = ref[name]
+        dt, sshape, all_nrows = ref[name][:3]
+        # Tiering follows the group: when EVERY survivor serves the
+        # variable from a read-only mapping (it was spilled/add_mmap'd),
+        # the replacement must come back the same way — mmap the
+        # checkpoint shard instead of re-materializing it in RAM, or one
+        # recovery would silently un-spill a variable that was spilled
+        # precisely because it does not fit.
+        tiered = all(v[name][3] for v in metas)
         dtype = np.dtype(dt)
         sample_shape = tuple(sshape)
         nrows = int(all_nrows[store.rank])
@@ -148,14 +167,29 @@ def _sync_state(store: DDStore, group, *, joiner: bool,
                         f"{tuple(side['sample_shape'])} but the group "
                         f"expects {nrows} rows of {dtype.str} "
                         f"{sample_shape} — stale or foreign checkpoint")
-            arr = np.fromfile(stem + ".bin", dtype=dtype).reshape(
-                (nrows,) + sample_shape)
+            if tiered:
+                arr = np.memmap(stem + ".bin", dtype=dtype, mode="r",
+                                shape=(nrows,) + sample_shape)
+            else:
+                arr = np.fromfile(stem + ".bin", dtype=dtype).reshape(
+                    (nrows,) + sample_shape)
         else:
             arr = np.empty((0,) + sample_shape, dtype)
-        store._native.add(name, np.ascontiguousarray(arr), all_nrows,
-                          copy=True)
-        store._meta[name] = _VarMeta(dtype, sample_shape,
-                                     _row_disp(sample_shape), all_nrows)
+        if tiered:
+            # Serve straight from page cache (the rejoin half of
+            # spill_to_disk): the mapping is pinned in the meta exactly
+            # like add_mmap's, and update stays refused.
+            store._native.add(name, arr, all_nrows, copy=False)
+            store._meta[name] = _VarMeta(dtype, sample_shape,
+                                         _row_disp(sample_shape),
+                                         all_nrows, pinned=arr,
+                                         readonly=True)
+        else:
+            store._native.add(name, np.ascontiguousarray(arr), all_nrows,
+                              copy=True)
+            store._meta[name] = _VarMeta(dtype, sample_shape,
+                                         _row_disp(sample_shape),
+                                         all_nrows)
     return joiners
 
 

@@ -69,10 +69,10 @@ int dds_update_peer(dds_handle* h, int target, const char* host_csv,
 
 int dds_routing_state(dds_handle* h, int cls, double* cma_bw,
                       double* tcp_bw, int64_t* decisions,
-                      int64_t* crossovers, int* via_tcp) {
+                      int64_t* crossovers, int* via_tcp, int* calibrated) {
   if (!h || !h->tcp) return dds::kErrInvalidArg;
   h->tcp->RoutingState(cls, cma_bw, tcp_bw, decisions, crossovers,
-                       via_tcp);
+                       via_tcp, calibrated);
   return dds::kOk;
 }
 

@@ -540,6 +540,11 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
       rc->cma_n = rc->tcp_n = rc->cold_skips = 0;
       rc->discard_probe = false;
       rc->cma_warmed = rc->tcp_warmed = false;
+      // Re-measurement from scratch includes the one-shot calibration:
+      // leaving it latched would route the fresh estimates through the
+      // hysteresis band only, re-introducing the parked-inside-the-band
+      // cold start for every post-replacement lifetime.
+      rc->calibrated = false;
     }
   }
   return kOk;
@@ -1096,6 +1101,10 @@ constexpr int64_t kBulkBytes = 8 << 20;
 // carries that overhead cheaper is a property of the kernel/NIC, not of
 // the bulk bandwidth — measured separately.
 constexpr int64_t kScatterMinOps = 64;
+// Clean warm samples each path needs before the router stops collecting
+// (shared by RouteViaTcp's collection phase and RecordRouteSample's
+// one-shot calibration).
+constexpr int kMinRouteSamples = 2;
 
 bool TcpTransport::RouteViaTcp(RouteClass& rc) {
   // The pin env ("1" = always CMA, "0" = always TCP) is read per call so
@@ -1113,7 +1122,6 @@ bool TcpTransport::RouteViaTcp(RouteClass& rc) {
   // path (and connect-tainted windows are now discarded entirely, see
   // RecordRouteSample, so collection keeps routing a path until a clean
   // sample actually lands).
-  constexpr int kMinRouteSamples = 2;
   // Consecutively per path (CMA's windows first, then TCP's), not
   // alternating: an isolated window on a path that just sat idle times
   // the re-warm (TCP slow-start restart, sleeping pool threads), and
@@ -1174,10 +1182,24 @@ void TcpTransport::RecordRouteSample(RouteClass& rc, bool via_tcp,
   double& est = via_tcp ? rc.tcp_bw : rc.cma_bw;
   est = est == 0.0 ? bw : 0.5 * est + 0.5 * bw;
   if (rc.cma_bw == 0.0 || rc.tcp_bw == 0.0) return;
-  // 1.25x hysteresis: flapping between near-equal paths costs probes and
-  // log noise for no bandwidth.
-  bool flip_to_tcp = !rc.via_tcp && rc.tcp_bw > 1.25 * rc.cma_bw;
-  bool flip_to_cma = rc.via_tcp && rc.cma_bw > 1.25 * rc.tcp_bw;
+  // One-shot warm calibration: the first moment BOTH paths hold clean
+  // warm estimates, park the class on the measured-faster one outright.
+  // Hysteresis exists to stop steady-state flapping between paths the
+  // EWMA ranks near-equal — applying it to the INITIAL verdict instead
+  // parked a cold start on whichever path happened to be the default
+  // whenever the faster one won by less than the band.
+  bool flip_to_tcp, flip_to_cma;
+  if (!rc.calibrated && rc.cma_n >= kMinRouteSamples &&
+      rc.tcp_n >= kMinRouteSamples) {
+    rc.calibrated = true;
+    flip_to_tcp = !rc.via_tcp && rc.tcp_bw > rc.cma_bw;
+    flip_to_cma = rc.via_tcp && rc.cma_bw > rc.tcp_bw;
+  } else {
+    // Per-class hysteresis: flapping between near-equal paths costs
+    // probes and log noise for no bandwidth (1.25x bulk, 1.1x scatter).
+    flip_to_tcp = !rc.via_tcp && rc.tcp_bw > rc.hysteresis * rc.cma_bw;
+    flip_to_cma = rc.via_tcp && rc.cma_bw > rc.hysteresis * rc.tcp_bw;
+  }
   if (flip_to_tcp || flip_to_cma) {
     rc.via_tcp = flip_to_tcp;
     ++rc.crossovers;
@@ -1191,7 +1213,7 @@ void TcpTransport::RecordRouteSample(RouteClass& rc, bool via_tcp,
 
 void TcpTransport::RoutingState(int cls, double* cma_bw, double* tcp_bw,
                                 int64_t* decisions, int64_t* crossovers,
-                                int* via_tcp) {
+                                int* via_tcp, int* calibrated) {
   std::lock_guard<std::mutex> lock(route_mu_);
   const RouteClass& rc = cls == 1 ? scatter_route_ : bulk_route_;
   *cma_bw = rc.cma_bw;
@@ -1199,6 +1221,7 @@ void TcpTransport::RoutingState(int cls, double* cma_bw, double* tcp_bw,
   *decisions = rc.decisions;
   *crossovers = rc.crossovers;
   *via_tcp = rc.via_tcp ? 1 : 0;
+  *calibrated = rc.calibrated ? 1 : 0;
 }
 
 int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,

@@ -134,7 +134,8 @@ class TcpTransport : public Transport {
   // 1 = scatter) — observability: exported into bench extras so routing
   // regressions are diagnosable from the JSON record alone.
   void RoutingState(int cls, double* cma_bw, double* tcp_bw,
-                    int64_t* decisions, int64_t* crossovers, int* via_tcp);
+                    int64_t* decisions, int64_t* crossovers, int* via_tcp,
+                    int* calibrated);
 
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
@@ -249,6 +250,13 @@ class TcpTransport : public Transport {
   struct RouteClass {
     const char* name;     // log/observability label
     const char* pin_env;  // env var pinning the choice
+    // Flip threshold for STEADY-STATE crossovers (the faster path must
+    // beat the current one by this factor). The scatter class runs a
+    // tighter band than bulk: its per-op-overhead bottleneck makes the
+    // paths land closer together, and a 1.25x band left it parked on a
+    // measurably slower path (auto_batch ~18% under the best forced
+    // path in BENCH r6).
+    double hysteresis = 1.25;
     double cma_bw = 0.0;  // EWMA bytes/s; 0 = no sample yet
     double tcp_bw = 0.0;
     int64_t decisions = 0;
@@ -270,9 +278,15 @@ class TcpTransport : public Transport {
     bool cma_warmed = false;
     bool tcp_warmed = false;
     bool via_tcp = false;
+    // One-shot warm calibration: once BOTH paths hold clean warm
+    // estimates (collection complete), the class is parked on the
+    // measured-faster path outright — hysteresis governs only LATER
+    // flips. Without it a cold start whose slower path was the default
+    // sat inside the hysteresis band forever.
+    bool calibrated = false;
   };
-  RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK"};
-  RouteClass scatter_route_{"scattered", "DDSTORE_CMA_SCATTER"};
+  RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK", 1.25};
+  RouteClass scatter_route_{"scattered", "DDSTORE_CMA_SCATTER", 1.10};
   unsigned hw_cores_ = 1;  // CMA striping is CPU-bound; never deal more
   //                          part-lists than cores (a 1-core box pays
   //                          pure dispatch overhead for each extra part)

@@ -14,9 +14,9 @@ from .pipeline import (interleave_order, interleave_stage_params,
                        pipeline_interleaved, pipeline_interleaved_1f1b,
                        stack_stage_params)
 from .ring_attention import ring_attention, ring_self_attention
-from .shuffle import (all_to_all_rows, global_shuffle_epoch,
-                      host_global_shuffle, permute_rows,
-                      ragged_global_shuffle)
+from .shuffle import (all_to_all_rows, exchange_rows,
+                      global_shuffle_epoch, host_global_shuffle,
+                      permute_rows, ragged_global_shuffle)
 from .tp import expert_rules, megatron_rules, shard_pytree, shardings_of
 
 __all__ = [
@@ -26,6 +26,7 @@ __all__ = [
     "batch_sharding",
     "replicate",
     "all_to_all_rows",
+    "exchange_rows",
     "permute_rows",
     "global_shuffle_epoch",
     "host_global_shuffle",

@@ -76,6 +76,34 @@ def global_shuffle_epoch(x: jax.Array, key: jax.Array, *, mesh: Mesh,
         body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis))(x, key)
 
 
+@partial(jax.jit, static_argnames=("mesh", "axis"))
+def exchange_rows(staged: jax.Array, inv: jax.Array, *, mesh: Mesh,
+                  axis: str = "dp") -> jax.Array:
+    """Deliver planner-staged rows to their destination shards over ICI.
+
+    The device half of the device-collective fetch
+    (``data/device_fetch.py``): each source shard holds a send buffer of
+    ``world`` equal blocks (block j = the rows it sends to shard j,
+    front-packed, padded to the plan's static per-pair capacity), and
+    ``inv`` carries each destination shard's gather indices into its
+    received ``(world * cap)`` rows — the inverse local permutation that
+    restores exact batch order and drops the padding. Shapes depend only
+    on (batch, mesh, world), never on the ownership pattern of one
+    batch, so jit compiles this once per configuration.
+    """
+
+    def body(xs, inv_local):
+        world = jax.lax.psum(1, axis)
+        blocks = xs.reshape((world, xs.shape[0] // world) + xs.shape[1:])
+        recv = jax.lax.all_to_all(blocks, axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        flat = recv.reshape(xs.shape)
+        return jnp.take(flat, inv_local, axis=0)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                         out_specs=P(axis))(staged, inv)
+
+
 def permute_rows(x: jax.Array, perm: jax.Array, mesh: Mesh,
                  axis: str = "dp") -> jax.Array:
     """Arbitrary global row permutation of a device-sharded array:

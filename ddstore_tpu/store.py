@@ -463,6 +463,31 @@ class DDStore:
         begin = int(sum(m.all_nrows[: self.rank]))
         return begin, begin + m.all_nrows[self.rank]
 
+    def row_starts(self, name: str) -> np.ndarray:
+        """Cumulative shard starts: ``row_starts[r]`` is the first global
+        row owned by rank r (length world+1; the trailing entry is
+        ``total_rows``). THE owner table the scatter-read planner
+        binary-searches in the native core, surfaced to Python for the
+        device-collective fetch planner."""
+        m = self._require(name)
+        return np.concatenate(
+            ([0], np.cumsum(np.asarray(m.all_nrows, np.int64))))
+
+    def owner_of_rows(self, name: str, indices) -> np.ndarray:
+        """Owning group rank of each global row index (vectorized
+        binary search over :meth:`row_starts`)."""
+        idx = np.ascontiguousarray(indices, dtype=np.int64).reshape(-1)
+        starts = self.row_starts(name)
+        if idx.size and (idx.min() < 0 or idx.max() >= starts[-1]):
+            raise IndexError(f"owner_of_rows({name}): index out of "
+                             f"range [0, {int(starts[-1])})")
+        return np.searchsorted(starts, idx, side="right") - 1
+
+    def row_nbytes(self, name: str) -> int:
+        """Bytes of one sample row (the bytes-moved ledger unit)."""
+        m = self._require(name)
+        return int(m.disp * m.dtype.itemsize)
+
     def variables(self):
         return sorted(self._meta)
 

@@ -108,6 +108,11 @@ class PipelineMetrics:
     carries the epoch's scatter-read planner statistics: how well the
     fetch path coalesced/deduped this epoch's batches."""
 
+    #: ledger counters accepted by :meth:`add_bytes` (anything else is
+    #: rejected loudly — a typo'd counter must not vanish silently)
+    BYTE_KEYS = ("bytes_local_get", "bytes_over_ici", "bytes_over_dcn",
+                 "rows_over_ici")
+
     def __init__(self, plan_source: Optional[Callable[[], Dict]] = None):
         self.wait = LatencyHistogram("device_wait")
         self.fetch = LatencyHistogram("host_fetch")
@@ -117,6 +122,11 @@ class PipelineMetrics:
         self._plan_source = plan_source
         self._plan_begin: Optional[Dict] = None
         self._plan_end: Optional[Dict] = None
+        # Bytes-moved ledger (device-collective fetch vs host path):
+        # which link carried this epoch's sample bytes. Guarded — the
+        # loader's worker pool records from several threads.
+        self._bytes_mu = threading.Lock()
+        self._bytes: Dict[str, int] = {k: 0 for k in self.BYTE_KEYS}
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -133,10 +143,27 @@ class PipelineMetrics:
             # A closed/torn-down store must not sink epoch accounting.
             return None
 
+    def add_bytes(self, **counters: int) -> None:
+        """Fold one fetch's bytes-moved ledger into the epoch totals
+        (``bytes_local_get`` / ``bytes_over_ici`` / ``bytes_over_dcn``
+        [+ ``rows_over_ici``] — the device-collective A/B ledger)."""
+        with self._bytes_mu:
+            for k, v in counters.items():
+                if k not in self._bytes:
+                    raise KeyError(f"unknown byte counter {k!r}; "
+                                   f"expected one of {self.BYTE_KEYS}")
+                self._bytes[k] += int(v)
+
+    def bytes_moved(self) -> Dict[str, int]:
+        with self._bytes_mu:
+            return dict(self._bytes)
+
     def epoch_start(self) -> None:
         self._t_start = time.perf_counter()
         self._plan_begin = self._snap_plan()
         self._plan_end = None
+        with self._bytes_mu:
+            self._bytes = {k: 0 for k in self.BYTE_KEYS}
 
     def epoch_end(self) -> None:
         self._t_end = time.perf_counter()
@@ -170,4 +197,7 @@ class PipelineMetrics:
                 else self._snap_plan()
             if end is not None:
                 out["scatter_plan"] = plan_stats_delta(self._plan_begin, end)
+        moved = self.bytes_moved()
+        if any(moved.values()):
+            out["bytes_moved"] = moved
         return out

@@ -49,6 +49,12 @@ def main():
     p.add_argument("--data-dir", type=str, default=None,
                    help="directory with MNIST idx files (plain or .gz); "
                         "omit for synthetic data")
+    p.add_argument("--device-collective", action="store_true",
+                   help="stage batches with the ICI device-collective "
+                        "fetch (one local read per host + on-device "
+                        "all_to_all) instead of the host DCN path; "
+                        "falls back automatically when no mesh supports "
+                        "it")
     args = p.parse_args()
 
     import jax
@@ -101,7 +107,13 @@ def main():
     for epoch in range(args.epochs):
         sampler.set_epoch(epoch)
         loader = DeviceLoader(ds, sampler, batch_size=per_proc_batch,
-                              mesh=mesh)
+                              mesh=mesh,
+                              device_collective=args.device_collective)
+        if args.device_collective \
+                and loader.collective_fallback_reason is not None \
+                and store.rank == 0 and epoch == 0:
+            print(f"device-collective fallback: "
+                  f"{loader.collective_fallback_reason}", flush=True)
         t0 = time.perf_counter()
         total, nb = 0.0, 0
         for step_i, xb in enumerate(loader):
@@ -119,7 +131,9 @@ def main():
                   f"{total / max(1, nb) / per_proc_batch:.3f} "
                   f"samples/s={sps:.0f} "
                   f"pipeline_eff={m['input_pipeline_efficiency']:.3f} "
-                  f"fetch_p50={m['host_fetch']['p50_s'] * 1e3:.2f}ms",
+                  f"fetch_p50={m['host_fetch']['p50_s'] * 1e3:.2f}ms"
+                  + (" bytes_moved=" + str(m["bytes_moved"])
+                     if "bytes_moved" in m else ""),
                   flush=True)
     store.close()
 

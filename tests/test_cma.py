@@ -276,6 +276,9 @@ def test_bulk_routing_policy_stable(tmp_path):
     assert st["bulk_decisions"] >= 48, st
     assert st["cma_bulk_gbps"] > 0 and st["tcp_bulk_gbps"] > 0, st
     assert st["bulk_crossovers"] <= 2, st
+    # Both paths collected clean warm samples: the one-shot calibration
+    # must have fired and parked the class on the measured-faster path.
+    assert st["bulk_calibrated"] is True, st
 
 
 def _worker_scatter_routing(rank, world, tmp, q, pin_env):
@@ -338,5 +341,17 @@ def test_scatter_routing_adaptive_stable(tmp_path):
     assert st["scatter_decisions"] >= 20, st
     assert st["cma_scatter_gbps"] > 0 and st["tcp_scatter_gbps"] > 0, st
     assert st["scatter_crossovers"] <= 2, st
+    # One-shot warm calibration (VERDICT r6 next #6): once both paths
+    # hold clean samples the class parks on the measured-faster one
+    # outright — a cold start can no longer sit on the slower path
+    # inside the hysteresis band. Steady state then honors the scatter
+    # class's tightened 1.1x band: a >1.1x measured gap at the end of
+    # the soak MUST be reflected in the preference.
+    assert st["scatter_calibrated"] is True, st
+    if st["tcp_scatter_gbps"] > 1.1 * st["cma_scatter_gbps"]:
+        assert st["scatter_via_tcp"] is True, st
+    elif st["cma_scatter_gbps"] > 1.1 * st["tcp_scatter_gbps"]:
+        assert st["scatter_via_tcp"] is False, st
     # The bulk class never saw a bulk-sized read: untouched.
     assert st["bulk_decisions"] == 0, st
+    assert st["bulk_calibrated"] is False, st
