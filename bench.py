@@ -370,6 +370,248 @@ def tcp_microbench(world=4, num=65536, dim=64):
     return results
 
 
+def _readahead_worker(rank, world, rdv, outfile, num, dim, batch,
+                      epochs, window):
+    """One readahead-bench rank over the real TCP/CMA transport. Rank 0
+    measures the same shuffled small-row epoch three ways — per-batch
+    ``get_batch`` scatter, windowed readahead (bulk sorted window
+    fetches through the native async engine), and the bulk-stripe
+    ceiling — after asserting the windowed delivery is byte-identical
+    to the per-batch path (the bench must fail loudly, not time wrong
+    code)."""
+    try:
+        import numpy as np
+
+        from ddstore_tpu import DDStore, FileGroup
+        from ddstore_tpu.data.readahead import EpochReadahead
+        from ddstore_tpu.utils.metrics import PipelineMetrics
+
+        g = FileGroup(rdv, rank, world)
+        res = {}
+        with DDStore(g, backend="tcp") as s:
+            s.add("bench", np.full((num, dim), rank + 1, np.float64))
+            s.barrier()
+            if rank == 0:
+                rng = np.random.default_rng(0)
+                # The shuffled small-row TRAINING stream: several full
+                # epoch permutations back to back (DistributedSampler
+                # semantics — every row exactly once per epoch), sliced
+                # into batches. Window density is what converts scatter
+                # into stripes: a window of W batches covers
+                # W*batch/total of every peer's shard, and sorted unique
+                # rows at density p coalesce into runs of ~1/(1-p) rows
+                # — the bench's W covers ~3/4 of the store per window,
+                # the "plan whole-epoch reads" regime.
+                total = world * num
+                nbatches = (total // batch) * epochs
+                stream = np.concatenate(
+                    [rng.permutation(total) for _ in range(epochs)])
+                batches = [stream[i * batch:(i + 1) * batch]
+                           for i in range(nbatches)]
+                if window is None:
+                    # THE tentpole regime: one window = one whole epoch
+                    # permutation, so each window's sorted unique rows
+                    # are every peer's full shard — the fetch leg
+                    # degenerates to one stripe per peer.
+                    window = total // batch
+
+                # Equivalence BEFORE timing, duplicates included.
+                eq = [np.concatenate([batches[0][:8], batches[0][:8]]),
+                      batches[1]]
+                with EpochReadahead(s, "bench", iter(eq),
+                                    window_batches=2, depth=2) as ra:
+                    for i, b in enumerate(eq):
+                        np.testing.assert_array_equal(
+                            ra.get_batch(i, idx=b), s.get_batch("bench", b))
+                assert s.async_pending() == 0
+
+                nbytes = len(stream) * dim * 8
+                dst = np.empty((batch, dim), np.float64)
+
+                def run_perbatch():
+                    for b in batches:
+                        s.get_batch("bench", b, out=dst)
+
+                metrics = PipelineMetrics()
+                ring_holder = {}
+
+                def run_windowed():
+                    # Ring handed engine to engine, like the loader does
+                    # epoch to epoch — the timed reps measure the
+                    # engine, not first-touch page faults on a fresh
+                    # 2-slot window ring.
+                    ra = EpochReadahead(s, "bench", iter(batches),
+                                        window_batches=window, depth=2,
+                                        metrics=metrics,
+                                        ring=ring_holder.get("r"))
+                    for i in range(nbatches):
+                        ra.get_batch(i)
+                    ra.close()
+                    ring_holder["r"] = ra.ring
+
+                res["readahead_perbatch_gbps"] = _best_bw(run_perbatch,
+                                                          nbytes)
+                # Explicit warm pass FIRST (allocates + first-touches
+                # the ring), THEN reset the window accounting — the
+                # reported stall/fetch numbers describe the same
+                # steady-state reps the bandwidth is measured on
+                # (_best_bw's own warm rep now runs with a warm ring).
+                run_windowed()
+                metrics.epoch_start()
+                res["readahead_windowed_gbps"] = _best_bw(run_windowed,
+                                                          nbytes)
+                # Bulk-stripe ceiling on the same transport, moving the
+                # SAME bytes to the same destination volume as one
+                # window fetch: every shard (local included) read
+                # contiguously into its slice of a window-sized buffer
+                # — peers sequential, which is the classic stripe-bench
+                # shape (the window fetch fans peers out in parallel;
+                # that concurrency is part of its design, not excluded
+                # from the comparison).
+                sdst = np.empty((total, dim), np.float64)
+
+                def run_stripe():
+                    for r in range(world):
+                        s.get("bench", r * num, num,
+                              out=sdst[r * num:(r + 1) * num])
+
+                res["readahead_stripe_gbps"] = _best_bw(
+                    run_stripe, total * dim * 8)
+                ra_sum = metrics.readahead_summary()
+                for k in ("windows", "runs_per_window",
+                          "runs_per_peer_per_window", "dedup_fraction",
+                          "consumer_wait_ms", "producer_idle_ms",
+                          "window_bytes", "window_fetch_gbps",
+                          "window_fetch_gbps_best"):
+                    res[f"readahead_{k}"] = ra_sum.get(k, 0)
+                res["readahead_vs_perbatch"] = round(
+                    res["readahead_windowed_gbps"]
+                    / res["readahead_perbatch_gbps"], 3) \
+                    if res["readahead_perbatch_gbps"] else 0.0
+                # The stripe comparison is transport-leg vs transport-
+                # leg, both measured UNCONTENDED: the best window's
+                # fetch bandwidth (the epoch's first window runs with
+                # nothing else in flight — steady-state windows compete
+                # with the previous window's delivery for this box's 2
+                # cores, which is the overlap working as designed, not
+                # transport inefficiency) against contiguous whole-
+                # shard reads on the same transport.
+                res["readahead_vs_stripe"] = round(
+                    res["readahead_window_fetch_gbps_best"]
+                    / res["readahead_stripe_gbps"], 3) \
+                    if res["readahead_stripe_gbps"] else 0.0
+                # Acceptance (recorded, not raised — one noisy window
+                # degrades a boolean, not the phase): windowed delivery
+                # >= 1.5x the per-batch scatter AND the window fetch
+                # leg >= 0.8x the stripe ceiling.
+                res["readahead_ok"] = bool(
+                    res["readahead_vs_perbatch"] >= 1.5
+                    and res["readahead_vs_stripe"] >= 0.8)
+
+                # Loader stall accounting at engine scale: the SAME
+                # store driven through DeviceLoader (host mode), bare
+                # consumer — the fetch>>step regime a TPU pipeline
+                # lives in (behind this box's CPU train steps, ~50x a
+                # TPU step, both waits read ~0 and the A/B measures
+                # nothing). Warm epoch first; the wait histogram
+                # accumulates across epochs, so report the delta.
+                from ddstore_tpu.data import (DeviceLoader,
+                                              DistributedSampler)
+
+                class _View:
+                    store, data_var = s, "bench"
+                    thread_safe = True
+
+                    def __len__(self):
+                        return total
+
+                    def fetch(self, indices):
+                        return s.get_batch(
+                            "bench", np.ascontiguousarray(
+                                indices, dtype=np.int64))
+
+                view = _View()
+                sampler = DistributedSampler(total, 1, 0, seed=1)
+                for label, kw in (
+                        ("perbatch", {}),
+                        ("readahead",
+                         dict(readahead_windows=2,
+                              readahead_window_batches=window))):
+                    ld = DeviceLoader(view, sampler, batch_size=batch,
+                                      prefetch=1, workers=1, **kw)
+                    prev, best = 0.0, float("inf")
+                    for pass_i in range(3):  # warm + best-of-2 measured
+                        sampler.set_epoch(pass_i)
+                        for _ in ld:
+                            pass
+                        cur = ld.metrics.wait.total
+                        if pass_i > 0:
+                            best = min(best, cur - prev)
+                        prev = cur
+                    res[f"readahead_loader_wait_ms_{label}"] = round(
+                        best * 1e3, 2)
+                pb = res["readahead_loader_wait_ms_perbatch"]
+                ra_w = res["readahead_loader_wait_ms_readahead"]
+                res["readahead_loader_wait_speedup"] = round(
+                    pb / ra_w, 2) if ra_w else 0.0
+                assert s.async_pending() == 0
+            s.barrier()
+        if rank == 0:
+            with open(outfile, "w") as f:
+                json.dump(res, f)
+    except BaseException:  # noqa: BLE001
+        import traceback
+        with open(outfile + f".err{rank}", "w") as f:
+            f.write(traceback.format_exc())
+
+
+def readahead_bench(world=4, num=32768, dim=64, batch=256, epochs=3,
+                    window=None):
+    """Windowed-readahead A/B over real processes + the CMA transport
+    (the transport whose scatter/stripe gap motivates the engine; both
+    classes forced to CMA so adaptive-routing noise can't blur the
+    comparison). Geometry: 131072 rows x 512 B across 4 ranks (16 MB
+    shards — cold-cache stripe volumes, same scale as the tcp phase's
+    cma_stripe), 3 back-to-back epoch permutations in 256-row batches;
+    the default window spans ONE whole epoch (the planner's unique
+    sorted rows then cover every peer's full shard — per-peer stripe
+    reads), ring depth 2."""
+    rdv = tempfile.mkdtemp()
+    outfile = os.path.join(rdv, "bench_out.json")
+    env = {"DDSTORE_CMA": "1", "DDSTORE_CMA_BULK": "1",
+           "DDSTORE_CMA_SCATTER": "1"}
+    backup = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        ctx = mp.get_context("spawn")
+        procs = [ctx.Process(target=_readahead_worker,
+                             args=(r, world, rdv, outfile, num, dim,
+                                   batch, epochs, window))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=600)
+            if p.is_alive():
+                p.terminate()
+    finally:
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if os.path.exists(outfile):
+        with open(outfile) as f:
+            return json.load(f)
+    for r in range(world):
+        err = outfile + f".err{r}"
+        if os.path.exists(err):
+            with open(err) as f:
+                print(f"# readahead bench rank {r} failed:\n{f.read()}",
+                      file=sys.stderr)
+    raise RuntimeError("readahead bench produced no record")
+
+
 def device_fetch_bench(samples=32768, dim=64, batch=2048, nbatches=16):
     """A/B of the two staging paths on the SAME shuffled index stream
     (ISSUE 2 tentpole): host ``get_batch`` + sharded device_put vs the
@@ -862,7 +1104,122 @@ def vae_pipeline_bench(samples=8192, batch=512, warm_epochs=2, epochs=5):
             return loss
 
         step_sps = _device_step_rate(one_step, batch)
-        return best_sps / n_dev, eff, n_dev, step_sps / n_dev
+
+        # Readahead stall A/B (ISSUE 3 acceptance): the SAME vae epochs
+        # trained per-batch and with a 2-deep window ring, over a store
+        # whose fetches actually cost something — a 4-owner ThreadGroup
+        # store on the TCP backend (real sockets/CMA in-process; the
+        # phase's own SingleGroup store serves every row as a local
+        # memcpy, which leaves no transport latency for readahead to
+        # hide). One worker + minimal prefetch keeps the fetch exposed
+        # (the 8-worker headline config hides it behind thread fan-out;
+        # readahead buys that hiding without burning a thread pool).
+        # Each config runs a warm epoch first (ring allocation and
+        # first-window fill are startup), then the measured epoch.
+        waits, ra_sum = _vae_wait_ab(data, mesh, state, step, key,
+                                     batch)
+        return (best_sps / n_dev, eff, n_dev, step_sps / n_dev, waits,
+                ra_sum)
+
+
+def _vae_wait_ab(data, mesh, state, step, key, batch):
+    """Consumer-wait A/B over a real transport: 4 ThreadGroup ranks on
+    the TCP backend serve the vae dataset in-process; rank 0 trains the
+    same jitted step per-batch vs with readahead and reports the
+    loader's consumer-wait totals (measured epoch only — the wait
+    histogram accumulates, so the warm epoch is subtracted).
+
+    Regime caveat, recorded here because the numbers need it: on this
+    CPU the vae step takes ~12 ms/batch — ~50x the TPU step the r5
+    profile measured — so the 0.1-0.4 ms steady-state fetches hide
+    behind it COMPLETELY for both paths and the waits land at the
+    sub-ms noise floor (pipeline efficiency reads 0.998 with or
+    without readahead). The transfer>>step regime where readahead's
+    overlap actually bites is measured at engine scale by the
+    `readahead` phase's loader A/B (`readahead_loader_wait_*`)."""
+    import threading
+    import uuid
+
+    import jax
+
+    from ddstore_tpu import DDStore, ThreadGroup
+    from ddstore_tpu.data import (DeviceLoader, DistributedSampler,
+                                  ShardedDataset)
+
+    world = 4
+    name = uuid.uuid4().hex
+    stop = threading.Event()
+    errors = []
+    # Price the fetches like DCN: force the socket path (no same-host
+    # CMA shortcut — warm CMA serves these 0.4 MB batches in ~0.1 ms,
+    # leaving nothing for readahead to hide; the pod-scale story this
+    # A/B stands in for is cross-host sockets). Must be set before ANY
+    # of the A/B stores (servers included) dial their peers.
+    cma_backup = os.environ.get("DDSTORE_CMA")
+    os.environ["DDSTORE_CMA"] = "0"
+
+    def server(rank):
+        try:
+            g = ThreadGroup(name, rank, world)
+            with DDStore(g, backend="tcp") as s:
+                ShardedDataset(s, data, name="vaeab")  # collective adds
+                stop.wait()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            stop.set()
+
+    ts = [threading.Thread(target=server, args=(r,))
+          for r in range(1, world)]
+    for t in ts:
+        t.start()
+    waits = {}
+    ra_sum = {}
+    try:
+        g0 = ThreadGroup(name, 0, world)
+        with DDStore(g0, backend="tcp") as s0:
+            ds = ShardedDataset(s0, data, name="vaeab")
+            sampler = DistributedSampler(len(ds), 1, 0, seed=3)
+            for label, kwargs in (
+                    ("perbatch", {}),
+                    ("readahead", dict(readahead_windows=3,
+                                       readahead_window_batches=2))):
+                # prefetch=1: no loader-side lookahead — per-batch
+                # then pays each fetch in full, and any hiding comes
+                # from the mechanism under test (the readahead engine
+                # prefetches windows independently of loader prefetch).
+                ld = DeviceLoader(ds, sampler, batch_size=batch,
+                                  mesh=mesh, prefetch=1, workers=1,
+                                  **kwargs)
+                warm_wait = 0.0
+                for pass_i in range(2):  # warm, then measured
+                    sampler.set_epoch(100 + pass_i)
+                    for xb in ld:
+                        key, sub = jax.random.split(key)
+                        state, loss = step(state, xb, sub)
+                    jax.block_until_ready(loss)
+                    if pass_i == 0:
+                        # The wait histogram accumulates across epochs;
+                        # subtract the warm epoch (ring allocation +
+                        # first-window fill are startup, not steady
+                        # state) so the record is the measured epoch
+                        # alone.
+                        warm_wait = ld.metrics.wait.total
+                waits[label] = (ld.metrics.wait.total - warm_wait) * 1e3
+                if label == "readahead":
+                    ra_sum = ld.metrics.readahead_summary()
+            assert s0.async_pending() == 0
+            stop.set()
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(120)
+        if cma_backup is None:
+            os.environ.pop("DDSTORE_CMA", None)
+        else:
+            os.environ["DDSTORE_CMA"] = cma_backup
+    if errors:
+        raise errors[0]
+    return waits, ra_sum
 
 
 def gnn_pipeline_bench(graphs=4096, graphs_per_slot=8, warm_epochs=1,
@@ -1081,14 +1438,24 @@ def _phase_soak():
 
 
 def _phase_vae():
-    sps_chip, eff, n_dev, step_sps = vae_pipeline_bench()
+    sps_chip, eff, n_dev, step_sps, waits, ra_sum = vae_pipeline_bench()
+    speed = waits["perbatch"] / waits["readahead"] \
+        if waits.get("readahead") else 0.0
     print(f"# vae pipeline: {sps_chip:.0f} samples/s/chip over {n_dev} "
           f"device(s), input-pipeline efficiency {eff:.3f}, "
-          f"device-step-only {step_sps:.0f} samples/s/chip",
+          f"device-step-only {step_sps:.0f} samples/s/chip; consumer "
+          f"wait {waits['perbatch']:.1f} ms per-batch -> "
+          f"{waits['readahead']:.1f} ms readahead ({speed:.1f}x less)",
           file=sys.stderr)
     return {"vae_samples_per_sec_per_chip": round(sps_chip, 1),
             "input_pipeline_eff": round(eff, 3),
-            "vae_step_samples_per_sec_per_chip": round(step_sps, 1)}
+            "vae_step_samples_per_sec_per_chip": round(step_sps, 1),
+            "vae_wait_ms_perbatch": round(waits["perbatch"], 2),
+            "vae_wait_ms_readahead": round(waits["readahead"], 2),
+            "vae_wait_speedup_readahead": round(speed, 2),
+            "vae_readahead_windows": ra_sum.get("windows", 0),
+            "vae_readahead_stall_ms": ra_sum.get("consumer_wait_ms", 0.0),
+            "vae_readahead_idle_ms": ra_sum.get("producer_idle_ms", 0.0)}
 
 
 def _phase_gnn():
@@ -1140,6 +1507,28 @@ def _phase_ppsched():
     return {f"ppsched_{k}": round(v, 4) for k, v in o.items()}
 
 
+def _phase_readahead():
+    o = readahead_bench()
+    print(f"# readahead A/B: per-batch "
+          f"{o.get('readahead_perbatch_gbps', 0):.2f} GB/s vs windowed "
+          f"{o.get('readahead_windowed_gbps', 0):.2f} GB/s delivered "
+          f"({o.get('readahead_vs_perbatch', 0):.2f}x); window fetch "
+          f"leg {o.get('readahead_window_fetch_gbps', 0):.2f} GB/s vs "
+          f"stripe {o.get('readahead_stripe_gbps', 0):.2f} GB/s "
+          f"({o.get('readahead_vs_stripe', 0):.2f}x of ceiling), "
+          f"{o.get('readahead_runs_per_peer_per_window', 0):.1f} "
+          f"runs/peer/window, stall "
+          f"{o.get('readahead_consumer_wait_ms', 0):.1f} ms; loader "
+          f"wait {o.get('readahead_loader_wait_ms_perbatch', 0):.1f} ms "
+          f"per-batch -> "
+          f"{o.get('readahead_loader_wait_ms_readahead', 0):.1f} ms "
+          f"readahead "
+          f"({o.get('readahead_loader_wait_speedup', 0):.1f}x less)",
+          file=sys.stderr)
+    return {k: (v if isinstance(v, (bool, int)) else round(v, 3))
+            for k, v in o.items()}
+
+
 def _phase_devicefetch():
     # CPU smoke runs get the 8-device virtual mesh the tests use (a real
     # accelerator run keeps its actual local devices). Safe here: this
@@ -1181,6 +1570,7 @@ def _phase_devicefetch():
 # under its own ~180 s subprocess cap, so even when it does run it
 # cannot eat a device phase's budget.
 _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
+           ("readahead", _phase_readahead),
            ("vae", _phase_vae), ("gnn", _phase_gnn),
            ("devicefetch", _phase_devicefetch),
            ("numerics", _phase_numerics), ("lm", _phase_lm),
@@ -1258,6 +1648,12 @@ def main():
     # finishes under this cap; the margin covers setup + teardown.
     soak_timeout = float(os.environ.get("DDSTORE_SOAK_PHASE_TIMEOUT_S",
                                         180))
+    # ppsched is a diagnostic too (r05: it hit the whole-run deadline
+    # and landed in failed_phases even though the isolated phase runs):
+    # its own subprocess budget keeps a slow interleaved-schedule
+    # compile from eating the record, same pattern as the soak cap.
+    ppsched_timeout = float(os.environ.get(
+        "DDSTORE_PPSCHED_PHASE_TIMEOUT_S", 420))
     # Whole-run budget: with a wedged accelerator EVERY device phase
     # hangs to its full per-phase timeout, and 6 x 1200s of silence
     # would outlive the caller's own patience with zero output. The
@@ -1280,7 +1676,7 @@ def main():
     # default (the safe default — only the three host-only phases are
     # exempt).
     device_phases = {n for n, _ in _PHASES
-                     if n not in ("local", "tcp", "soak")}
+                     if n not in ("local", "tcp", "readahead", "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -1383,7 +1779,8 @@ def main():
                 [sys.executable, os.path.abspath(__file__),
                  "--phase", name],
                 stdout=subprocess.PIPE, start_new_session=True)
-            phase_timeout = soak_timeout if name == "soak" else timeout
+            phase_timeout = {"soak": soak_timeout,
+                             "ppsched": ppsched_timeout}.get(name, timeout)
             try:
                 out, _ = proc.communicate(timeout=min(phase_timeout, left))
             except subprocess.TimeoutExpired:

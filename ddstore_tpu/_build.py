@@ -52,11 +52,30 @@ def _stale(lib_path: str) -> bool:
     return False
 
 
+def _sweep_strays(max_age_s: float = 600.0) -> None:
+    """Remove leaked build-staging files (``_lib/tmp*.so``). A build
+    killed between mkstemp and its cleanup leaks the staging file; a
+    LIVE concurrent build's temp is at most seconds old, so anything
+    older than ``max_age_s`` is provably dead. Runs at EVERY build()
+    entry — including the fresh-cache early return, which is where the
+    old sweep never fired and four strays accumulated (ISSUE 3)."""
+    import glob
+    import time as _time
+    for stray in glob.glob(os.path.join(_LIB_DIR, "tmp*.so")):
+        try:
+            if _time.time() - os.path.getmtime(stray) > max_age_s:
+                os.unlink(stray)
+        except OSError:
+            pass
+
+
 def build(force: bool = False) -> str:
     """Returns the path to the built shared library, compiling if needed."""
     mode = _sanitize_mode()
     lib_path = _lib_path(mode)
     with _lock:
+        if os.path.isdir(_LIB_DIR) and os.access(_LIB_DIR, os.W_OK):
+            _sweep_strays()
         if not force and not _stale(lib_path):
             return lib_path
         # Installed wheels bundle the library (setup.py build_native); the
@@ -76,18 +95,8 @@ def build(force: bool = False) -> str:
         cmd += [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
         # Build to a temp path then rename: concurrent test processes may
         # race on the build, and dlopen of a half-written .so is fatal.
-        # A build killed between mkstemp and the finally below leaks its
-        # staging file (the observed _lib/tmp*.so strays). Sweep old ones
-        # here: anything past an hour cannot belong to a live concurrent
-        # build, whose compile takes seconds.
-        import glob
-        import time as _time
-        for stray in glob.glob(os.path.join(_LIB_DIR, "tmp*.so")):
-            try:
-                if _time.time() - os.path.getmtime(stray) > 3600:
-                    os.unlink(stray)
-            except OSError:
-                pass
+        # The finally below cleans the staging file on every non-killed
+        # exit; _sweep_strays above catches the SIGKILL leaks.
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
         os.close(fd)
         try:

@@ -70,6 +70,20 @@ def _load() -> ctypes.CDLL:
     lib.dds_get_batch.restype = ctypes.c_int
     lib.dds_get_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_void_p, _i64p, _i64]
+    lib.dds_get_batch_async.restype = _i64
+    lib.dds_get_batch_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_void_p, _i64p, _i64]
+    lib.dds_read_runs_async.restype = _i64
+    lib.dds_read_runs_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_void_p, _i64p, _i64p,
+                                        _i64p, _i64p, _i64]
+    lib.dds_async_wait.restype = ctypes.c_int
+    lib.dds_async_wait.argtypes = [ctypes.c_void_p, _i64, _i64,
+                                   ctypes.POINTER(ctypes.c_double)]
+    lib.dds_async_release.restype = ctypes.c_int
+    lib.dds_async_release.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_async_pending.restype = _i64
+    lib.dds_async_pending.argtypes = [ctypes.c_void_p]
     lib.dds_query.restype = ctypes.c_int
     lib.dds_query.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _i64p, _i64p,
                               _i64p, _i64p]
@@ -265,6 +279,70 @@ class NativeStore:
         _check(self._lib.dds_get_batch(self._h, name.encode(),
                                        out.ctypes.data, _as_i64p(starts),
                                        len(starts)), f"get_batch({name})")
+
+    # -- async batched reads ----------------------------------------------
+    #
+    # The epoch-readahead engine's native leg: the read runs on the
+    # store's background pool while Python keeps planning/consuming. The
+    # caller must keep `out` alive until the ticket completes (the
+    # high-level AsyncBatchRead handle holds the reference); `starts` is
+    # copied at issue time.
+
+    def get_batch_async(self, name: str, out: np.ndarray,
+                        starts: np.ndarray) -> int:
+        assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
+        starts = np.ascontiguousarray(starts, dtype=np.int64)
+        ticket = self._lib.dds_get_batch_async(
+            self._h, name.encode(), out.ctypes.data, _as_i64p(starts),
+            len(starts))
+        if ticket < 0:
+            raise DDStoreError(int(ticket), f"get_batch_async({name})")
+        return int(ticket)
+
+    def read_runs_async(self, name: str, out: np.ndarray,
+                        targets: np.ndarray, src_off: np.ndarray,
+                        dst_off: np.ndarray, nbytes: np.ndarray) -> int:
+        """Async vectored run read: the caller's pre-coalesced per-peer
+        runs executed verbatim (O(runs), not O(rows)) — the readahead
+        window fast path. Bounds of every dst span are validated here;
+        src spans are validated by the local/remote read legs."""
+        assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
+        arrs = [np.ascontiguousarray(a, dtype=np.int64)
+                for a in (targets, src_off, dst_off, nbytes)]
+        n = len(arrs[0])
+        if not all(len(a) == n for a in arrs):
+            raise ValueError("read_runs_async: array length mismatch")
+        if n and int((arrs[2] + arrs[3]).max()) > out.nbytes:
+            raise ValueError("read_runs_async: dst span exceeds out")
+        ticket = self._lib.dds_read_runs_async(
+            self._h, name.encode(), out.ctypes.data, _as_i64p(arrs[0]),
+            _as_i64p(arrs[1]), _as_i64p(arrs[2]), _as_i64p(arrs[3]), n)
+        if ticket < 0:
+            raise DDStoreError(int(ticket), f"read_runs_async({name})")
+        return int(ticket)
+
+    def async_wait(self, ticket: int, timeout_ms: int = -1):
+        """Wait for an async read. Returns ``(status, done_mono_s)``:
+        status 1 = done ok, 0 = timeout, <0 = the read's error code.
+        ``done_mono_s`` is the completion time on the time.monotonic()
+        clock (producer-idle accounting). The status is returned raw —
+        the high-level handle must release the ticket even for a failed
+        read, so raising here would leak it."""
+        ts = ctypes.c_double(0.0)
+        rc = self._lib.dds_async_wait(self._h, ticket, timeout_ms,
+                                      ctypes.byref(ts))
+        return rc, ts.value
+
+    def async_release(self, ticket: int) -> int:
+        """Block until the read completes, then free the ticket. Returns
+        the read's error code (0 = ok) — never raises: release is the
+        teardown barrier and must always free the slot."""
+        return int(self._lib.dds_async_release(self._h, ticket))
+
+    @property
+    def async_pending(self) -> int:
+        """Unreleased async tickets (0 after a clean loader teardown)."""
+        return int(self._lib.dds_async_pending(self._h))
 
     def query(self, name: str):
         total = _i64(0)

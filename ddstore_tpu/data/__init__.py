@@ -12,9 +12,13 @@ from .graphs import (GraphBatch, GraphSample, GraphShardedDataset,
 from .loader import DeviceLoader
 from .ragged import (pack_ragged, pad_ragged, segment_ids_from_lengths,
                      split_ragged)
+from .readahead import (EpochReadahead, WindowPlan, plan_epoch_windows,
+                        plan_window)
 
 __all__ = ["ShardedDataset", "DistributedSampler", "DeviceLoader", "nsplit",
            "FeistelPermutation",
+           "EpochReadahead", "WindowPlan", "plan_window",
+           "plan_epoch_windows",
            "plan_device_fetch", "device_fetch_batch",
            "device_fetch_ragged_batch", "host_bytes_over_dcn",
            "pad_ragged", "pack_ragged", "split_ragged",

@@ -228,13 +228,32 @@ class StagedFetch:
 
 def stage_batch(store, name: str, indices, n_shards: int,
                 plan: Optional[DeviceFetchPlan] = None,
-                metrics=None) -> StagedFetch:
+                metrics=None,
+                rows: Optional[np.ndarray] = None) -> StagedFetch:
     """Host half: partition by owner, read each owner's rows LOCALLY,
-    pack them into the padded send buffer. Thread-safe."""
+    pack them into the padded send buffer. Thread-safe.
+
+    ``rows``, when given, are the batch's rows already in batch order
+    (the epoch-readahead window gather): no store reads happen here —
+    the rows scatter straight into the send buffer, and only the ICI leg
+    is ledgered (the window fetch recorded its transport bytes once,
+    dedup included)."""
     m = store._require(name)
     if plan is None:
         plan = plan_device_fetch(store.row_starts(name), indices, n_shards)
     staged = np.zeros((plan.staged_rows,) + m.sample_shape, m.dtype)
+    if rows is not None:
+        if len(rows) != plan.idx.size:
+            raise ValueError(f"stage_batch({name}): {len(rows)} "
+                             f"prefetched rows for a {plan.idx.size}-row "
+                             f"batch")
+        staged[plan.staged_pos] = rows
+        if metrics is not None:
+            led = plan.bytes_ledger(store.row_nbytes(name),
+                                    rank=store.rank)
+            metrics.add_bytes(bytes_over_ici=led["bytes_over_ici"],
+                              rows_over_ici=led["rows_over_ici"])
+        return StagedFetch(plan, staged)
     for w, pw in enumerate(plan.owner_positions):
         if pw.size == 0:
             continue
@@ -245,8 +264,8 @@ def stage_batch(store, name: str, indices, n_shards: int,
         # jax.make_array_from_process_local_data just its local shard
         # slice) is not built yet — exchange_staged refuses multi-process
         # meshes loudly rather than silently pulling remote rows here.
-        rows = store.get_batch(name, plan.idx[pw])
-        staged[plan.staged_pos[pw]] = rows
+        got = store.get_batch(name, plan.idx[pw])
+        staged[plan.staged_pos[pw]] = got
     if metrics is not None:
         # rank-aware: other owners' rows staged through THIS handle
         # crossed the host transport and are ledgered as DCN, not

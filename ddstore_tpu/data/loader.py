@@ -82,6 +82,25 @@ class DeviceLoader:
         default ``P(axis)`` spec, no host transform, and a store-backed
         dataset exposing ``data_var``; anything else falls back to the
         host path with the reason in ``collective_fallback_reason``.
+    readahead_windows: > 0 enables epoch-window readahead
+        (``data/readahead.py``): the sampler's whole epoch is sliced
+        into windows of ``readahead_window_batches`` batches, each
+        window's rows fetched as ONE sorted deduplicated bulk read per
+        variable through the native async engine into a preallocated
+        staging ring of this many buffers — window N+1 stays in flight
+        over the transport while window N is consumed, and per-batch
+        delivery is an in-RAM gather. Composes with both the host path
+        and ``device_collective`` (window staging happens before the
+        ICI exchange). Needs a store-backed dataset (``store`` +
+        fixed-width ``data_var``) and a *sized, replayable* sampler
+        (two iterations yield identical indices — every
+        ``DistributedSampler`` qualifies; a one-shot generator does
+        not); otherwise the loader falls back to per-batch fetch with
+        the reason in ``readahead_fallback_reason``.
+    readahead_window_batches: window size W in batches (default 8).
+        Bigger windows coalesce better (denser rows per peer shard →
+        longer stripe-shaped runs) at the cost of staging memory:
+        ``readahead_windows × W × batch_size`` rows per variable.
     transform: optional host-side function applied to each fetched batch.
         With workers > 1 the transform is serialized under a lock (fetch
         and staging still run in parallel), so stateful transforms — e.g.
@@ -100,7 +119,9 @@ class DeviceLoader:
                  spec: Optional["PartitionSpec"] = None,
                  workers: Optional[int] = None,
                  transform_thread_safe: bool = False,
-                 device_collective: bool = False):
+                 device_collective: bool = False,
+                 readahead_windows: int = 0,
+                 readahead_window_batches: int = 8):
         self.dataset = dataset
         self.sampler = sampler
         self.batch_size = int(batch_size)
@@ -155,6 +176,44 @@ class DeviceLoader:
         if self.device_collective:
             self._collective_ready = self._collective_usable(
                 dataset, mesh, axis, spec, transform)
+        # Epoch-window readahead (`readahead_windows=K`): whole-epoch
+        # read planning + bulk window fetches through the native async
+        # engine, per-batch delivery as in-RAM gathers. Usability is
+        # checked once here; the engine itself is per-epoch (built in
+        # __iter__, closed in its finally — mid-epoch teardown waits
+        # out and releases every in-flight native read).
+        self.readahead_windows = max(0, int(readahead_windows))
+        self.readahead_window_batches = max(1,
+                                            int(readahead_window_batches))
+        self.readahead_fallback_reason: Optional[str] = None
+        self._readahead_ready = False
+        # Staging ring handed from epoch to epoch (reallocating +
+        # re-faulting the window buffers every epoch costs real time).
+        self._ra_ring = None
+        if self.readahead_windows > 0:
+            self._readahead_ready = self._readahead_usable()
+
+    def _readahead_usable(self) -> bool:
+        store = getattr(self.dataset, "store", None)
+        data_var = getattr(self.dataset, "data_var", None)
+        reason = None
+        if store is None or data_var is None:
+            reason = "dataset exposes no store/data_var"
+        elif store.is_ragged(data_var):
+            # The engine itself handles ragged windows, but a ragged
+            # dataset's fetch() does sample packing the loader cannot
+            # reproduce from raw rows — per-batch path keeps it exact.
+            reason = "ragged data_var (dataset.fetch packs samples)"
+        elif not hasattr(self.sampler, "__len__"):
+            reason = "sampler is not sized"
+        elif iter(self.sampler) is self.sampler:
+            reason = ("sampler is a one-shot iterator (readahead "
+                      "replays the epoch; two iterations must yield "
+                      "identical indices)")
+        if reason is not None:
+            self.readahead_fallback_reason = reason
+            return False
+        return True
 
     def _collective_usable(self, dataset, mesh, axis, spec,
                            transform) -> bool:
@@ -203,7 +262,8 @@ class DeviceLoader:
             dcn += host_bytes_over_dcn(store, label_var, idx)
         self.metrics.add_bytes(bytes_over_dcn=dcn)
 
-    def _fetch_collective(self, idx: np.ndarray):
+    def _fetch_collective(self, idx: np.ndarray, seq: int = 0,
+                          ra=None):
         """Host half of the collective staging, on a WORKER thread:
         plan + local reads + send-buffer fill. Returns a thunk the
         consumer thread runs to dispatch the exchange — collective
@@ -211,7 +271,10 @@ class DeviceLoader:
         per-device executors and deadlock (see
         ``device_fetch.StagedFetch``), so the exchange must ride the
         same thread as the train step. Raises ValueError for geometries
-        the planner rejects (caller falls back per batch)."""
+        the planner rejects (caller falls back per batch). With a
+        readahead engine (``ra``), the send buffers are filled from the
+        staged window instead of per-owner store reads — window staging
+        happens per host BEFORE the ICI exchange."""
         from .device_fetch import (exchange_staged, plan_device_fetch,
                                    stage_batch)
 
@@ -220,15 +283,21 @@ class DeviceLoader:
         d = int(self.mesh.shape[self.axis])
         with self.metrics.fetch.timed(), annotate("ddstore:device_fetch"):
             plan = plan_device_fetch(store.row_starts(data_var), idx, d)
+            # Consume the window delivery only once the plan is viable —
+            # a ValueError above falls back to the host path, which will
+            # consume this seq itself.
+            rows = ra.batch_rows(seq, idx=idx) if ra is not None else []
             staged = [stage_batch(store, data_var, idx, d, plan=plan,
-                                  metrics=self.metrics)]
+                                  metrics=self.metrics,
+                                  rows=rows[0] if rows else None)]
             label_var = getattr(self.dataset, "label_var", None)
             if label_var is not None:
                 # Labels share the plan: same indices, same shard split
                 # (ShardedDataset registers both with one nsplit).
-                staged.append(stage_batch(store, label_var, idx, d,
-                                          plan=plan,
-                                          metrics=self.metrics))
+                staged.append(stage_batch(
+                    store, label_var, idx, d, plan=plan,
+                    metrics=self.metrics,
+                    rows=rows[1] if len(rows) > 1 else None))
 
         def finalize():
             with self.metrics.stage.timed(), \
@@ -251,19 +320,26 @@ class DeviceLoader:
                 return
             yield np.asarray(idx, dtype=np.int64)
 
-    def _fetch(self, idx: np.ndarray):
+    def _fetch(self, idx: np.ndarray, seq: int = 0, ra=None):
         if self._collective_ready:
             try:
-                return self._fetch_collective(idx)
+                return self._fetch_collective(idx, seq, ra)
             except ValueError:
                 # A geometry this batch can't satisfy (e.g. a short
                 # trailing batch with drop_last=False): host path for
                 # this batch only.
                 pass
         with self.metrics.fetch.timed(), annotate("ddstore:fetch"):
-            batch = (self.dataset(idx) if callable(self.dataset)
-                     else self.dataset.fetch(idx))
-            self._record_host_dcn(idx)
+            if ra is not None:
+                # Window delivery: an in-RAM gather from the staged
+                # window (the engine recorded the transport-side bytes
+                # once per window, dedup included — no per-batch DCN
+                # accounting here).
+                batch = ra.get_batch(seq, idx=idx)
+            else:
+                batch = (self.dataset(idx) if callable(self.dataset)
+                         else self.dataset.fetch(idx))
+                self._record_host_dcn(idx)
         if self.transform is not None:
             if self._transform_lock is not None:
                 with self._transform_lock:
@@ -279,20 +355,42 @@ class DeviceLoader:
             # batches like GraphBatch, dicts) while staging every leaf.
             return jax.tree_util.tree_map(put, batch)
 
+    def _make_readahead(self):
+        """Per-epoch readahead engine over a SECOND, independent replay
+        of the sampler (the engine verifies both replays agree batch by
+        batch). None when readahead is off or fell back."""
+        if not self._readahead_ready:
+            return None
+        from .readahead import EpochReadahead
+
+        # Check the ring OUT for this iterator (restored at teardown):
+        # two overlapping iterators of one loader must never share
+        # staging buffers — the second allocates its own.
+        ring, self._ra_ring = self._ra_ring, None
+        return EpochReadahead(
+            self.dataset.store, self.dataset.data_var,
+            self._index_batches(),
+            label_var=getattr(self.dataset, "label_var", None),
+            window_batches=self.readahead_window_batches,
+            depth=self.readahead_windows, metrics=self.metrics,
+            ring=ring)
+
     def __iter__(self):
         # Ordered worker pool: index batches are submitted in order and
         # futures consumed in submission order, so parallel fetch+stage
         # never reorders the epoch's batch stream. Early exit (break) is
-        # safe: shutdown waits for in-flight fetches, so a subsequent
-        # store teardown can't race them.
+        # safe: shutdown waits for in-flight fetches, then the readahead
+        # engine's close() releases every in-flight async read, so a
+        # subsequent store teardown can't race either.
         self.metrics.epoch_start()
         ex = ThreadPoolExecutor(max_workers=self.workers,
                                 thread_name_prefix="ddstore-loader")
         futs = deque()
+        ra = self._make_readahead()
         try:
-            it = self._index_batches()
-            for idx in itertools.islice(it, self.prefetch):
-                futs.append(ex.submit(self._fetch, idx))
+            it = enumerate(self._index_batches())
+            for seq, idx in itertools.islice(it, self.prefetch):
+                futs.append(ex.submit(self._fetch, idx, seq, ra))
             while futs:
                 t0 = time.perf_counter()
                 item = futs.popleft().result()
@@ -304,11 +402,18 @@ class DeviceLoader:
                 self.metrics.wait.record(time.perf_counter() - t0)
                 nxt = next(it, None)
                 if nxt is not None:
-                    futs.append(ex.submit(self._fetch, nxt))
+                    futs.append(ex.submit(self._fetch, nxt[1], nxt[0],
+                                          ra))
                 yield item
         finally:
             for f in futs:
                 f.cancel()
+            if ra is not None:
+                # Wake any worker blocked on a window BEFORE joining the
+                # pool: shutdown(wait=True) on a worker waiting for a
+                # ring slot that will never free would deadlock.
+                ra.close()
+                self._ra_ring = ra.ring  # reuse next epoch
             ex.shutdown(wait=True)
             self.metrics.epoch_end()
 

@@ -118,6 +118,47 @@ int dds_get_batch(dds_handle* h, const char* name, void* dst,
   return h->store->GetBatch(name, dst, starts, n);
 }
 
+// Async batched reads (the epoch-readahead engine's native leg): issue a
+// GetBatch on the store's background pool, poll/wait, release. See
+// Store::GetBatchAsync for the contract (dst stays alive until the
+// ticket completes; Release blocks until the read finishes).
+int64_t dds_get_batch_async(dds_handle* h, const char* name, void* dst,
+                            const int64_t* starts, int64_t n) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GetBatchAsync(name, dst, starts, n);
+}
+
+// Async vectored run read (the readahead window fast path): executes
+// the caller's pre-coalesced per-peer runs without re-deriving the
+// plan — O(runs), not O(rows). See Store::ReadRunsAsync.
+int64_t dds_read_runs_async(dds_handle* h, const char* name, void* dst,
+                            const int64_t* targets,
+                            const int64_t* src_off,
+                            const int64_t* dst_off, const int64_t* nbytes,
+                            int64_t nruns) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->ReadRunsAsync(name, dst, targets, src_off, dst_off,
+                                 nbytes, nruns);
+}
+
+// 1 = done ok; 0 = still in flight after timeout_ms (0 polls, negative
+// waits forever); <0 = error. `done_mono_s` (nullable) receives the
+// CLOCK_MONOTONIC completion time, comparable to time.monotonic().
+int dds_async_wait(dds_handle* h, int64_t ticket, int64_t timeout_ms,
+                   double* done_mono_s) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->AsyncWait(ticket, timeout_ms, done_mono_s);
+}
+
+int dds_async_release(dds_handle* h, int64_t ticket) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->AsyncRelease(ticket);
+}
+
+int64_t dds_async_pending(dds_handle* h) {
+  return h ? h->store->AsyncPending() : 0;
+}
+
 int dds_query(dds_handle* h, const char* name, int64_t* total_rows,
               int64_t* disp, int64_t* itemsize, int64_t* local_rows) {
   if (!h) return dds::kErrInvalidArg;

@@ -1,12 +1,24 @@
 #include "store.h"
 
 #include <algorithm>
+#include <chrono>
 #include <cstring>
 #include <memory>
 
 #include "worker_pool.h"
 
 namespace dds {
+
+namespace {
+double MonoSeconds() {
+  // steady_clock is CLOCK_MONOTONIC on Linux/glibc — the same clock
+  // Python's time.monotonic() reads, so completion timestamps compare
+  // directly against consumer-side timestamps.
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
 
 const char* ErrorString(int code) {
   switch (code) {
@@ -27,7 +39,22 @@ const char* ErrorString(int code) {
 Store::Store(std::unique_ptr<Transport> transport)
     : transport_(std::move(transport)) {}
 
-Store::~Store() { FreeAll(); }
+Store::~Store() {
+  // In-flight async reads hold the shared lock and use the transport;
+  // both must still exist while they finish.
+  DrainAsync();
+  FreeAll();
+}
+
+void Store::DrainAsync() {
+  std::unique_ptr<WorkerPool> pool;
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    pool = std::move(async_pool_);
+    async_.clear();  // workers hold their AsyncState via shared_ptr
+  }
+  pool.reset();  // WorkerPool dtor runs every queued task, then joins
+}
 
 int Store::rank() const { return transport_->rank(); }
 int Store::world() const { return transport_->world(); }
@@ -168,12 +195,19 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   // like best.
   std::vector<std::pair<int64_t, int64_t>> order;  // (row, slot)
   order.reserve(n);
+  bool presorted = true;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t row = starts[i];
     if (row < 0 || row >= total) return kErrOutOfRange;
+    presorted = presorted && (i == 0 || row >= starts[i - 1]);
     order.emplace_back(row, i);
   }
-  std::sort(order.begin(), order.end());
+  // Already-sorted requests (the epoch-readahead engine always submits
+  // sorted deduplicated window rows) skip the O(n log n) sort — at
+  // window scale (10^5+ rows) the sort otherwise rivals the copy time.
+  // Slots ascend with equal rows in input order, so `order` is already
+  // in (row, slot) order.
+  if (!presorted) std::sort(order.begin(), order.end());
 
   // Duplicate rows: keep the first occurrence in `order` (compacted in
   // place), remember the rest as post-fetch replications.
@@ -326,6 +360,160 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
 PlanStats Store::plan_stats() const {
   std::lock_guard<std::mutex> lock(stats_mu_);
   return stats_;
+}
+
+int64_t Store::SubmitAsync(std::function<int()> fn) {
+  auto st = std::make_shared<AsyncState>();
+  int64_t ticket;
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    if (!async_pool_) {
+      // 2 threads: one window in flight is the steady state (the ring
+      // keeps window N+1 fetching while N is consumed); the second
+      // absorbs a co-variable (labels) issued alongside.
+      async_pool_.reset(new WorkerPool(2));
+    }
+    ticket = next_ticket_++;
+    async_[ticket] = st;
+  }
+  // async_pool_ is stable once created (only DrainAsync moves it, and
+  // callers must not race teardown with new issues).
+  async_pool_->Submit([fn = std::move(fn), st]() {
+    int rc = fn();
+    std::lock_guard<std::mutex> lock(st->mu);
+    st->rc = rc;
+    st->done_mono_s = MonoSeconds();
+    st->done = true;
+    st->cv.notify_all();
+  });
+  return ticket;
+}
+
+int64_t Store::GetBatchAsync(const std::string& name, void* dst,
+                             const int64_t* starts, int64_t n) {
+  if (!dst || !starts || n < 0) return kErrInvalidArg;
+  std::vector<int64_t> idx(starts, starts + n);
+  return SubmitAsync([this, name, dst, idx = std::move(idx)]() {
+    return GetBatch(name, dst, idx.data(),
+                    static_cast<int64_t>(idx.size()));
+  });
+}
+
+int64_t Store::ReadRunsAsync(const std::string& name, void* dst,
+                             const int64_t* targets,
+                             const int64_t* src_off,
+                             const int64_t* dst_off,
+                             const int64_t* nbytes, int64_t nruns) {
+  if (!dst || !targets || !src_off || !dst_off || !nbytes || nruns < 0)
+    return kErrInvalidArg;
+  std::vector<int64_t> t(targets, targets + nruns);
+  std::vector<int64_t> so(src_off, src_off + nruns);
+  std::vector<int64_t> dof(dst_off, dst_off + nruns);
+  std::vector<int64_t> nb(nbytes, nbytes + nruns);
+  return SubmitAsync([this, name, dst, t = std::move(t),
+                      so = std::move(so), dof = std::move(dof),
+                      nb = std::move(nb)]() {
+    return ReadRuns(name, static_cast<char*>(dst), t, so, dof, nb);
+  });
+}
+
+int Store::ReadRuns(const std::string& name, char* dst,
+                    const std::vector<int64_t>& targets,
+                    const std::vector<int64_t>& src_off,
+                    const std::vector<int64_t>& dst_off,
+                    const std::vector<int64_t>& nbytes) {
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  const int64_t nruns = static_cast<int64_t>(targets.size());
+  std::vector<ReadOp> local_ops;
+  std::map<int, std::vector<ReadOp>> by_peer;
+  for (int64_t i = 0; i < nruns; ++i) {
+    if (targets[i] < 0 || targets[i] >= world() || nbytes[i] < 0 ||
+        dst_off[i] < 0)
+      return kErrInvalidArg;
+    ReadOp op{src_off[i], nbytes[i], dst + dst_off[i]};
+    if (targets[i] == rank()) {
+      local_ops.push_back(op);
+    } else {
+      by_peer[static_cast<int>(targets[i])].push_back(op);
+    }
+  }
+  // Execute exactly like GetBatch's leg: local copies overlap the
+  // remote fan-out on the transport pool when both are present.
+  constexpr int64_t kOverlapMinLocalBytes = 64 << 10;
+  int64_t local_bytes = 0;
+  for (const ReadOp& op : local_ops) local_bytes += op.nbytes;
+  WorkerPool* pool = by_peer.empty() ? nullptr : transport_->worker_pool();
+  int local_rc = kOk;
+  std::unique_ptr<TaskGroup> local_group;
+  if (!local_ops.empty()) {
+    if (pool && local_bytes >= kOverlapMinLocalBytes) {
+      local_group.reset(new TaskGroup(pool));
+      local_group->Launch([this, &name, &local_ops, &local_rc]() {
+        local_rc = ReadLocalV(name, local_ops.data(),
+                              static_cast<int64_t>(local_ops.size()));
+      });
+    } else {
+      local_rc = ReadLocalV(name, local_ops.data(),
+                            static_cast<int64_t>(local_ops.size()));
+      if (local_rc != kOk) return local_rc;
+    }
+  }
+  if (!by_peer.empty()) {
+    std::vector<PeerReadV> reqs;
+    reqs.reserve(by_peer.size());
+    for (auto& kv : by_peer)
+      reqs.push_back(PeerReadV{kv.first, kv.second.data(),
+                               static_cast<int64_t>(kv.second.size())});
+    int rc = transport_->ReadVMulti(name, reqs.data(),
+                                    static_cast<int64_t>(reqs.size()));
+    if (rc != kOk) {
+      if (local_group) local_group->Wait();
+      return rc;
+    }
+  }
+  if (local_group) local_group->Wait();
+  return local_rc;
+}
+
+int Store::AsyncWait(int64_t ticket, int64_t timeout_ms,
+                     double* done_mono_s) {
+  std::shared_ptr<AsyncState> st;
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    auto it = async_.find(ticket);
+    if (it == async_.end()) return kErrInvalidArg;
+    st = it->second;
+  }
+  std::unique_lock<std::mutex> lock(st->mu);
+  auto ready = [&st] { return st->done; };
+  if (timeout_ms < 0) {
+    st->cv.wait(lock, ready);
+  } else if (!st->cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                              ready)) {
+    return 0;
+  }
+  if (done_mono_s) *done_mono_s = st->done_mono_s;
+  return st->rc == kOk ? 1 : st->rc;
+}
+
+int Store::AsyncRelease(int64_t ticket) {
+  std::shared_ptr<AsyncState> st;
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    auto it = async_.find(ticket);
+    if (it == async_.end()) return kErrInvalidArg;
+    st = it->second;
+    async_.erase(it);
+  }
+  std::unique_lock<std::mutex> lock(st->mu);
+  st->cv.wait(lock, [&st] { return st->done; });
+  return st->rc;
+}
+
+int64_t Store::AsyncPending() const {
+  std::lock_guard<std::mutex> lock(async_mu_);
+  return static_cast<int64_t>(async_.size());
 }
 
 int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,

@@ -18,6 +18,7 @@
 #ifndef DDSTORE_TPU_STORE_H_
 #define DDSTORE_TPU_STORE_H_
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <functional>
@@ -240,6 +241,56 @@ class Store {
   // Snapshot of the cumulative scatter-read planner statistics.
   PlanStats plan_stats() const;
 
+  // -- async batched reads ------------------------------------------------
+  //
+  // The epoch-readahead engine's native leg: issue a GetBatch in the
+  // background and poll/wait for completion, so Python can keep the NEXT
+  // readahead window's bulk fetch in flight while the current one is
+  // consumed. The read runs on a small dedicated pool — NOT the
+  // transport's worker pool: GetBatch itself fans its per-peer run lists
+  // out over that pool and Wait()s on them, and a waiting task occupying
+  // a transport worker could exhaust the thread cap with every worker
+  // blocked on leaves that can no longer run.
+  //
+  // `dst` and `starts`' rows are copied at issue time; `dst` must stay
+  // alive (and unread) until the ticket completes. Tickets are released
+  // explicitly; Release blocks until the read finishes (there is no
+  // mid-flight cancel — a transport read cannot be safely abandoned
+  // while the worker may still write into `dst`), which is exactly the
+  // teardown barrier loader cancellation needs.
+
+  // Returns a positive ticket, or a negative ErrorCode on invalid args.
+  int64_t GetBatchAsync(const std::string& name, void* dst,
+                        const int64_t* starts, int64_t n);
+
+  // Async vectored run read — the readahead window fast path. The
+  // caller (the Python window planner) has already sorted,
+  // deduplicated, and coalesced its rows into per-peer runs; this
+  // entry executes exactly those runs without re-deriving the plan
+  // (O(runs) instead of O(rows) — at window scale, 10^5+ rows in ~4
+  // runs, the planner pass otherwise rivals the copy time). Run i
+  // reads nbytes[i] at byte offset src_off[i] of targets[i]'s shard
+  // into dst + dst_off[i]. Same ticket/waiting contract as
+  // GetBatchAsync; all four arrays are copied at issue time.
+  int64_t ReadRunsAsync(const std::string& name, void* dst,
+                        const int64_t* targets, const int64_t* src_off,
+                        const int64_t* dst_off, const int64_t* nbytes,
+                        int64_t nruns);
+  // 1 = done ok; 0 = still in flight after `timeout_ms` (0 polls,
+  // negative waits forever); <0 = the completed read's error, or
+  // kErrInvalidArg for an unknown/released ticket. `done_mono_s`, when
+  // non-null and the read is done, receives the CLOCK_MONOTONIC
+  // completion time (seconds) — comparable to Python's time.monotonic(),
+  // the readahead producer-idle accounting.
+  int AsyncWait(int64_t ticket, int64_t timeout_ms,
+                double* done_mono_s = nullptr);
+  // Blocks until the read completes, then frees the ticket. Returns the
+  // read's ErrorCode (kErrInvalidArg for an unknown ticket).
+  int AsyncRelease(int64_t ticket);
+  // Unreleased tickets (in flight or completed-but-held). A clean loader
+  // teardown leaves this at 0.
+  int64_t AsyncPending() const;
+
   // Metadata query: total rows across all ranks (reference `query`,
   // src/ddstore.cxx:46-49) plus shape info.
   int Query(const std::string& name, int64_t* total_rows, int64_t* disp,
@@ -323,6 +374,30 @@ class Store {
   // mutex is fine — one lock per batch, not per row).
   mutable std::mutex stats_mu_;
   PlanStats stats_;
+
+  // Async batched-read engine. The completion state is shared_ptr'd so a
+  // worker finishing after Release (or ~Store's drain) never touches a
+  // freed entry.
+  struct AsyncState {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    int rc = kOk;
+    double done_mono_s = 0.0;  // CLOCK_MONOTONIC completion time
+  };
+  void DrainAsync();  // ~Store: finish every in-flight read, drop the pool
+  // Synchronous body of ReadRunsAsync, run on the async pool.
+  int ReadRuns(const std::string& name, char* dst,
+               const std::vector<int64_t>& targets,
+               const std::vector<int64_t>& src_off,
+               const std::vector<int64_t>& dst_off,
+               const std::vector<int64_t>& nbytes);
+  // Shared issue half of GetBatchAsync/ReadRunsAsync.
+  int64_t SubmitAsync(std::function<int()> fn);
+  mutable std::mutex async_mu_;
+  int64_t next_ticket_ = 1;
+  std::map<int64_t, std::shared_ptr<AsyncState>> async_;
+  std::unique_ptr<WorkerPool> async_pool_;  // lazily created, 2 threads
 };
 
 }  // namespace dds

@@ -23,7 +23,83 @@ from .binding import DDStoreError, NativeStore
 from .rendezvous import (ProcessGroup, SingleGroup, ThreadGroup,
                          auto_group)
 
-__all__ = ["DDStore", "DDStoreError"]
+__all__ = ["AsyncBatchRead", "DDStore", "DDStoreError"]
+
+
+class AsyncBatchRead:
+    """Handle to an in-flight background :meth:`DDStore.get_batch`.
+
+    The read fills the preallocated ``out`` buffer on the native store's
+    background pool; the handle keeps ``out`` (and the index array)
+    alive until completion. ``wait()`` blocks (GIL released — the wait
+    is a native condition variable), returns the filled buffer, and
+    releases the native ticket; ``done()`` polls. There is no mid-flight
+    cancel: ``release()`` on an unfinished read blocks until it
+    completes — the teardown barrier that guarantees no worker is still
+    writing into ``out`` when the caller drops it.
+    """
+
+    __slots__ = ("_native", "_ticket", "out", "_idx", "_released",
+                 "_error", "done_mono_s")
+
+    def __init__(self, native, ticket: int, out: np.ndarray,
+                 idx: np.ndarray):
+        self._native = native
+        self._ticket = ticket
+        self.out = out
+        self._idx = idx  # starts are copied natively; held for debugging
+        self._released = False
+        self._error: Optional[int] = None  # the read's error code, if any
+        #: completion time on the time.monotonic() clock, set by the
+        #: first successful wait (readahead producer-idle accounting).
+        self.done_mono_s: Optional[float] = None
+
+    def done(self) -> bool:
+        """Poll without blocking. Raises (and frees the ticket) if the
+        read failed."""
+        if self._released:
+            if self._error is not None:
+                raise DDStoreError(self._error, "get_batch_async")
+            return True
+        status, ts = self._native.async_wait(self._ticket, 0)
+        if status < 0:
+            self._error = status
+            self.release()
+            raise DDStoreError(status, "get_batch_async")
+        if status == 1:
+            self.done_mono_s = ts
+        return status == 1
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the read completes; returns the filled buffer and
+        releases the ticket. Raises TimeoutError if ``timeout`` (seconds)
+        elapses first, DDStoreError if the read failed — including on a
+        repeat call after a failure already surfaced (the buffer was
+        never filled; returning it would look like success)."""
+        if self._released:
+            if self._error is not None:
+                raise DDStoreError(self._error, "get_batch_async")
+            return self.out
+        ms = -1 if timeout is None else max(0, int(timeout * 1000))
+        status, ts = self._native.async_wait(self._ticket, ms)
+        if status == 0:
+            raise TimeoutError(
+                f"async get_batch not done after {timeout}s")
+        if status < 0:
+            self._error = status
+        self.release()
+        if status < 0:
+            raise DDStoreError(status, "get_batch_async")
+        self.done_mono_s = ts
+        return self.out
+
+    def release(self) -> None:
+        """Free the native ticket, blocking until the read finishes (a
+        worker must never be left writing into ``out``). Idempotent and
+        non-raising — this is the teardown barrier."""
+        if not self._released:
+            self._released = True
+            self._native.async_release(self._ticket)
 
 
 def _row_disp(sample_shape: Tuple[int, ...]) -> int:
@@ -278,6 +354,38 @@ class DDStore:
         out = self._check_out(name, m, out, len(idx))
         self._native.get_batch(name, out, idx)
         return out
+
+    def get_batch_async(self, name: str, indices,
+                        out: Optional[np.ndarray] = None) -> AsyncBatchRead:
+        """Issue :meth:`get_batch` on the native background pool and
+        return immediately with an :class:`AsyncBatchRead` handle — the
+        epoch-readahead engine keeps the next window's bulk fetch in
+        flight this way while the current window is consumed. ``out``
+        must not be read (or dropped) until the handle completes."""
+        m = self._require(name)
+        idx = np.ascontiguousarray(indices, dtype=np.int64).reshape(-1)
+        out = self._check_out(name, m, out, len(idx))
+        ticket = self._native.get_batch_async(name, out, idx)
+        return AsyncBatchRead(self._native, ticket, out, idx)
+
+    def read_runs_async(self, name: str, out: np.ndarray, targets,
+                        src_offsets, dst_offsets,
+                        nbytes) -> AsyncBatchRead:
+        """Issue pre-coalesced per-peer runs (byte spans) in the
+        background — the readahead window fast path: the window planner
+        already sorted/deduped/coalesced its rows, so the native side
+        executes O(runs) work instead of re-planning O(rows). Run i
+        reads ``nbytes[i]`` at byte offset ``src_offsets[i]`` of
+        ``targets[i]``'s shard into ``out`` at byte ``dst_offsets[i]``.
+        Same completion contract as :meth:`get_batch_async`."""
+        self._require(name)
+        ticket = self._native.read_runs_async(
+            name, out, targets, src_offsets, dst_offsets, nbytes)
+        return AsyncBatchRead(self._native, ticket, out, None)
+
+    def async_pending(self) -> int:
+        """In-flight / unreleased async reads (0 after clean teardown)."""
+        return self._native.async_pending
 
     @staticmethod
     def _check_out(name: str, m: "_VarMeta", out: Optional[np.ndarray],

@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class LatencyHistogram:
@@ -113,10 +113,22 @@ class PipelineMetrics:
     BYTE_KEYS = ("bytes_local_get", "bytes_over_ici", "bytes_over_dcn",
                  "rows_over_ici")
 
+    #: per-window readahead counters accepted by :meth:`add_window`
+    WINDOW_KEYS = ("rows_requested", "rows_unique", "dup_rows", "runs",
+                   "remote_runs", "peer_lists", "window_bytes")
+
     def __init__(self, plan_source: Optional[Callable[[], Dict]] = None):
         self.wait = LatencyHistogram("device_wait")
         self.fetch = LatencyHistogram("host_fetch")
         self.stage = LatencyHistogram("device_put")
+        # Readahead window accounting: how long the consumer stalled on
+        # an unfinished window fetch vs how long staged windows sat
+        # ready ahead of need (the overlap headroom), plus the fetch
+        # leg's own wall time (issue -> transport completion — the
+        # number comparable to bulk-stripe bandwidth).
+        self.ra_wait = LatencyHistogram("readahead_consumer_wait")
+        self.ra_idle = LatencyHistogram("readahead_producer_idle")
+        self.ra_fetch = LatencyHistogram("readahead_window_fetch")
         self._t_start: Optional[float] = None
         self._t_end: Optional[float] = None
         self._plan_source = plan_source
@@ -127,6 +139,13 @@ class PipelineMetrics:
         # loader's worker pool records from several threads.
         self._bytes_mu = threading.Lock()
         self._bytes: Dict[str, int] = {k: 0 for k in self.BYTE_KEYS}
+        self._ra_mu = threading.Lock()
+        self._ra: Dict[str, int] = {k: 0 for k in self.WINDOW_KEYS}
+        self._ra_windows = 0
+        # (bytes, fetch_s) per window, for the honest per-window best
+        # bandwidth (bounded: one entry per window, windows are O(epoch
+        # batches / W)).
+        self._ra_fetch_samples: List[Tuple[int, float]] = []
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -158,12 +177,79 @@ class PipelineMetrics:
         with self._bytes_mu:
             return dict(self._bytes)
 
+    def add_window(self, *, wait_s: float, idle_s: float,
+                   fetch_s: float = 0.0, **counters: int) -> None:
+        """Fold one readahead window's accounting into the epoch totals:
+        ``wait_s`` = consumer stall on the window's fetch, ``idle_s`` =
+        how long the staged window sat ready before first touch,
+        ``fetch_s`` = the fetch leg's issue→completion wall time, plus
+        the :data:`WINDOW_KEYS` counters (rows/dups/runs/peers/bytes)."""
+        self.ra_wait.record(wait_s)
+        self.ra_idle.record(idle_s)
+        self.ra_fetch.record(fetch_s)
+        with self._ra_mu:
+            self._ra_windows += 1
+            if len(self._ra_fetch_samples) < (1 << 16):
+                self._ra_fetch_samples.append(
+                    (int(counters.get("window_bytes", 0)), fetch_s))
+            for k, v in counters.items():
+                if k not in self._ra:
+                    raise KeyError(f"unknown window counter {k!r}; "
+                                   f"expected one of {self.WINDOW_KEYS}")
+                self._ra[k] += int(v)
+
+    def readahead_summary(self) -> Dict:
+        """Per-epoch readahead view: window totals plus the derived
+        per-window rates (runs/peer/window is THE transport fan-out a
+        window fetch pays) and the stall/idle milliseconds."""
+        with self._ra_mu:
+            n = self._ra_windows
+            out: Dict = {"windows": n}
+            out.update(self._ra)
+            samples = list(self._ra_fetch_samples)
+        out["consumer_wait_ms"] = round(self.ra_wait.total * 1e3, 3)
+        out["producer_idle_ms"] = round(self.ra_idle.total * 1e3, 3)
+        # Transport-leg bandwidth of the window fetches themselves
+        # (issue -> completion), independent of delivery/gather time.
+        # The mean is the overlapped steady state (fetch competes with
+        # the previous window's delivery for cores/memory bandwidth);
+        # `_best` is the fastest window — typically the first of an
+        # epoch, fetched with nothing else running — the uncontended
+        # transport capability, measured the same way a bulk-stripe
+        # benchmark is.
+        out["window_fetch_gbps"] = round(
+            out["window_bytes"] / self.ra_fetch.total / 1e9, 3) \
+            if self.ra_fetch.total > 0 else 0.0
+        best = max((b / s for b, s in samples if s > 0 and b > 0),
+                   default=0.0)
+        if best:
+            # Per-window best: each window's OWN bytes over its own
+            # fetch time (mean-bytes / min-time would overstate it
+            # whenever a short trailing window posts the minimum).
+            out["window_fetch_gbps_best"] = round(best / 1e9, 3)
+        if n:
+            out["runs_per_window"] = round(out["runs"] / n, 2)
+            out["runs_per_peer_per_window"] = round(
+                out["remote_runs"] / out["peer_lists"], 2) \
+                if out["peer_lists"] else 0.0
+            out["dedup_fraction"] = round(
+                out["dup_rows"] / out["rows_requested"], 4) \
+                if out["rows_requested"] else 0.0
+        return out
+
     def epoch_start(self) -> None:
         self._t_start = time.perf_counter()
         self._plan_begin = self._snap_plan()
         self._plan_end = None
         with self._bytes_mu:
             self._bytes = {k: 0 for k in self.BYTE_KEYS}
+        with self._ra_mu:
+            self._ra = {k: 0 for k in self.WINDOW_KEYS}
+            self._ra_windows = 0
+            self._ra_fetch_samples = []
+        self.ra_wait = LatencyHistogram("readahead_consumer_wait")
+        self.ra_idle = LatencyHistogram("readahead_producer_idle")
+        self.ra_fetch = LatencyHistogram("readahead_window_fetch")
 
     def epoch_end(self) -> None:
         self._t_end = time.perf_counter()
@@ -200,4 +286,6 @@ class PipelineMetrics:
         moved = self.bytes_moved()
         if any(moved.values()):
             out["bytes_moved"] = moved
+        if self._ra_windows:
+            out["readahead"] = self.readahead_summary()
         return out

@@ -49,6 +49,13 @@ def main():
     p.add_argument("--data-dir", type=str, default=None,
                    help="directory with MNIST idx files (plain or .gz); "
                         "omit for synthetic data")
+    p.add_argument("--readahead-windows", type=int, default=0,
+                   help="epoch-window readahead ring depth (0 = off): "
+                        "whole-epoch read planning, bulk window fetches "
+                        "through the native async engine, window N+1 in "
+                        "flight while N is consumed")
+    p.add_argument("--readahead-window-batches", type=int, default=8,
+                   help="window size W in batches for --readahead-windows")
     p.add_argument("--device-collective", action="store_true",
                    help="stage batches with the ICI device-collective "
                         "fetch (one local read per host + on-device "
@@ -106,14 +113,21 @@ def main():
     key = jax.random.key(args.seed + 1)
     for epoch in range(args.epochs):
         sampler.set_epoch(epoch)
-        loader = DeviceLoader(ds, sampler, batch_size=per_proc_batch,
-                              mesh=mesh,
-                              device_collective=args.device_collective)
+        loader = DeviceLoader(
+            ds, sampler, batch_size=per_proc_batch, mesh=mesh,
+            device_collective=args.device_collective,
+            readahead_windows=args.readahead_windows,
+            readahead_window_batches=args.readahead_window_batches)
         if args.device_collective \
                 and loader.collective_fallback_reason is not None \
                 and store.rank == 0 and epoch == 0:
             print(f"device-collective fallback: "
                   f"{loader.collective_fallback_reason}", flush=True)
+        if args.readahead_windows \
+                and loader.readahead_fallback_reason is not None \
+                and store.rank == 0 and epoch == 0:
+            print(f"readahead fallback: "
+                  f"{loader.readahead_fallback_reason}", flush=True)
         t0 = time.perf_counter()
         total, nb = 0.0, 0
         for step_i, xb in enumerate(loader):

@@ -85,7 +85,16 @@ def _sanitizer_lib(mode):
     return path if os.path.isabs(path) else None
 
 
-@pytest.mark.parametrize("mode", ["thread", "address"])
+@pytest.mark.parametrize("mode", [
+    # TSan hangs under this container's gVisor kernel (verified against
+    # the pre-change tree too: the stress subprocess never finishes and
+    # burns its whole 600 s timeout) — 70% of the 870 s tier-1 budget on
+    # one hung test was why the suite never reached test_tiering..xent.
+    # Marked slow; the ASan variant stays as the sanitizer family's
+    # tier-1 representative (it passes in ~30 s).
+    pytest.param("thread", marks=pytest.mark.slow),
+    "address",
+])
 def test_native_stress_under_sanitizer(mode, tmp_path):
     lib = _sanitizer_lib(mode)
     if lib is None:
