@@ -722,6 +722,134 @@ def device_fetch_bench(samples=32768, dim=64, batch=2048, nbatches=16):
     return out
 
 
+def chaos_bench(world=4, num=16384, dim=64, batch=256):
+    """Chaos A/B (ISSUE 4 acceptance): a multi-owner ThreadGroup TCP
+    store runs one loader epoch per path (host per-batch AND windowed
+    readahead) fault-free, then repeats both with the deterministic
+    injector firing resets/truncations/delays/stalls at ~1% of served
+    ops — the epochs must come back BYTE-IDENTICAL with nonzero retry
+    counters and zero give-ups. DDSTORE_CMA=0 forces every remote read
+    onto the wire path (the injector lives in the serve loop);
+    DDSTORE_READ_TIMEOUT_S is tightened so the stall kind actually
+    trips the client timeout instead of reading as a long delay, and
+    the retry knobs keep the chaos epochs under the phase's own
+    subprocess cap (DDSTORE_CHAOS_PHASE_TIMEOUT_S)."""
+    import threading
+    import uuid
+
+    import numpy as np
+
+    from ddstore_tpu import DDStore, ThreadGroup, fault_configure
+    from ddstore_tpu.data import DistributedSampler, ShardedDataset
+    from ddstore_tpu.data.loader import DeviceLoader
+
+    env = {"DDSTORE_CMA": "0", "DDSTORE_READ_TIMEOUT_S": "2",
+           "DDSTORE_RETRY_MAX": "8", "DDSTORE_RETRY_BASE_MS": "5",
+           "DDSTORE_OP_DEADLINE_S": "60"}
+    backup = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    out = {}
+    errors = []
+    name = uuid.uuid4().hex
+    try:
+        def run_rank(rank):
+            g = ThreadGroup(name, rank, world)
+            rng = np.random.default_rng(5)
+            data = rng.standard_normal((num, dim)).astype(np.float32)
+            with DDStore(g, backend="tcp") as s:
+                ds = ShardedDataset(s, data)
+                if rank == 0:
+                    sampler = DistributedSampler(num, world=1, rank=0,
+                                                 seed=11)
+
+                    def epoch(ra_windows):
+                        loader = DeviceLoader(
+                            ds, sampler, batch_size=batch, mesh=None,
+                            readahead_windows=ra_windows,
+                            readahead_window_batches=8)
+                        t0 = time.perf_counter()
+                        batches = [b.copy() for b in loader]
+                        return batches, time.perf_counter() - t0, loader
+
+                    ref, t_pb, _ = epoch(0)
+                    ref_ra, t_ra, _ = epoch(2)
+                    for a, b in zip(ref, ref_ra):
+                        np.testing.assert_array_equal(a, b)
+                    fault_configure(
+                        "reset:0.01,trunc:0.005,delay:0.02:5,"
+                        "stall:0.002:2500", 1234)
+                    fs0 = s.fault_stats()
+                    try:
+                        chaos_pb, ct_pb, _ = epoch(0)
+                        chaos_ra, ct_ra, l_ra = epoch(2)
+                        # Snapshot BEFORE disarming: fault_configure
+                        # resets the injector counters.
+                        fs = s.fault_stats()
+                    finally:
+                        fault_configure("", 0)
+                    # Equivalence FIRST: the bench must fail loudly, not
+                    # time (or certify) wrong bytes. Batch COUNTS too —
+                    # zip alone would certify an epoch that silently
+                    # dropped its tail.
+                    assert len(ref) == len(ref_ra) == len(chaos_pb) \
+                        == len(chaos_ra), (len(ref), len(ref_ra),
+                                           len(chaos_pb), len(chaos_ra))
+                    for a, b in zip(ref, chaos_pb):
+                        np.testing.assert_array_equal(a, b)
+                    for a, b in zip(ref, chaos_ra):
+                        np.testing.assert_array_equal(a, b)
+                    injected = sum(
+                        fs[k] - fs0[k]
+                        for k in ("injected_reset", "injected_trunc",
+                                  "injected_delay", "injected_stall"))
+                    fsum = l_ra.metrics.summary().get("faults", {})
+                    out.update({
+                        "chaos_injected": injected,
+                        "chaos_retries": fs["retry_attempts"]
+                        - fs0["retry_attempts"],
+                        "chaos_reconnects": fs["retry_reconnects"]
+                        - fs0["retry_reconnects"],
+                        "chaos_giveups": fs["retry_giveups"]
+                        - fs0["retry_giveups"],
+                        "chaos_windows_retried":
+                            fsum.get("windows_retried", 0),
+                        "chaos_epoch_overhead_x": round(
+                            (ct_pb + ct_ra) / (t_pb + t_ra), 3)
+                            if t_pb + t_ra > 0 else 0.0,
+                        # byte-identical asserted above; nonzero
+                        # injections + zero give-ups = faults were both
+                        # PROVOKED and ABSORBED
+                        "chaos_ok": injected > 0
+                        and fs["retry_giveups"] == fs0["retry_giveups"],
+                    })
+                s.barrier()
+
+        def body(rank):
+            try:
+                run_rank(rank)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(280)
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("chaos_bench rank thread hung past its "
+                               "280 s join")
+    finally:
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Device benchmarks (LM + VAE).
 # ---------------------------------------------------------------------------
@@ -1529,6 +1657,18 @@ def _phase_readahead():
             for k, v in o.items()}
 
 
+def _phase_chaos():
+    o = chaos_bench()
+    print(f"# chaos: {o.get('chaos_injected', 0)} faults injected -> "
+          f"{o.get('chaos_retries', 0)} retries "
+          f"({o.get('chaos_reconnects', 0)} reconnects, "
+          f"{o.get('chaos_windows_retried', 0)} window retries), "
+          f"{o.get('chaos_giveups', 0)} give-ups, byte-identical epochs, "
+          f"{o.get('chaos_epoch_overhead_x', 0):.2f}x wall overhead -> "
+          f"{'OK' if o.get('chaos_ok') else 'NOT OK'}", file=sys.stderr)
+    return o
+
+
 def _phase_devicefetch():
     # CPU smoke runs get the 8-device virtual mesh the tests use (a real
     # accelerator run keeps its actual local devices). Safe here: this
@@ -1575,7 +1715,8 @@ _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("devicefetch", _phase_devicefetch),
            ("numerics", _phase_numerics), ("lm", _phase_lm),
            ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong),
-           ("ppsched", _phase_ppsched), ("soak", _phase_soak))
+           ("ppsched", _phase_ppsched), ("chaos", _phase_chaos),
+           ("soak", _phase_soak))
 
 
 def _kill_group(proc):
@@ -1654,6 +1795,12 @@ def main():
     # compile from eating the record, same pattern as the soak cap.
     ppsched_timeout = float(os.environ.get(
         "DDSTORE_PPSCHED_PHASE_TIMEOUT_S", 420))
+    # The chaos phase is a diagnostic with deliberately injected stalls
+    # and retry backoff in its wall time: its own cap (pattern of the
+    # soak/ppsched caps) keeps a pathological schedule from eating a
+    # device phase's budget.
+    chaos_timeout = float(os.environ.get(
+        "DDSTORE_CHAOS_PHASE_TIMEOUT_S", 300))
     # Whole-run budget: with a wedged accelerator EVERY device phase
     # hangs to its full per-phase timeout, and 6 x 1200s of silence
     # would outlive the caller's own patience with zero output. The
@@ -1676,7 +1823,8 @@ def main():
     # default (the safe default — only the three host-only phases are
     # exempt).
     device_phases = {n for n, _ in _PHASES
-                     if n not in ("local", "tcp", "readahead", "soak")}
+                     if n not in ("local", "tcp", "readahead", "chaos",
+                                  "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -1780,7 +1928,8 @@ def main():
                  "--phase", name],
                 stdout=subprocess.PIPE, start_new_session=True)
             phase_timeout = {"soak": soak_timeout,
-                             "ppsched": ppsched_timeout}.get(name, timeout)
+                             "ppsched": ppsched_timeout,
+                             "chaos": chaos_timeout}.get(name, timeout)
             try:
                 out, _ = proc.communicate(timeout=min(phase_timeout, left))
             except subprocess.TimeoutExpired:

@@ -22,7 +22,8 @@ Layers (bottom-up):
 """
 
 from . import _compat  # noqa: F401  — jax API aliases for older runtimes
-from .binding import DDStoreError, NativeStore, owner_of
+from .binding import (DDStoreError, NativeStore, fault_configure,
+                      owner_of)
 from .elastic import recover as elastic_recover
 from .elastic import rejoin as elastic_rejoin
 from .rendezvous import (FileGroup, JaxGroup, PodConfig, ProcessGroup,
@@ -36,6 +37,7 @@ __all__ = [
     "DDStore",
     "DDStoreError",
     "NativeStore",
+    "fault_configure",
     "owner_of",
     "ProcessGroup",
     "SingleGroup",

@@ -107,6 +107,11 @@ def _load() -> ctypes.CDLL:
     lib.dds_uds_conns.argtypes = [ctypes.c_void_p]
     lib.dds_plan_stats.restype = ctypes.c_int
     lib.dds_plan_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_fault_configure.restype = ctypes.c_int
+    lib.dds_fault_configure.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_char_p]
+    lib.dds_fault_stats.restype = ctypes.c_int
+    lib.dds_fault_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_rank.restype = ctypes.c_int
     lib.dds_rank.argtypes = [ctypes.c_void_p]
     lib.dds_world.restype = ctypes.c_int
@@ -121,6 +126,13 @@ def _load() -> ctypes.CDLL:
     lib.dds_owner_of.argtypes = [_i64p, ctypes.c_int, _i64]
     _lib = lib
     return lib
+
+
+# Error codes tested by the Python-side classification (mirrors
+# dds::ErrorCode; see native/store.h).
+ERR_TRANSPORT = -6   # transient-class transport failure
+ERR_PEER_LOST = -10  # transient-retry budget exhausted: owner presumed
+#                      dead — fatal, invoke elastic.recover
 
 
 class DDStoreError(RuntimeError):
@@ -143,6 +155,32 @@ def owner_of(cum: Sequence[int], row: int) -> int:
     """Owner rank of global row `row` given cumulative row counts."""
     arr = np.ascontiguousarray(cum, dtype=np.int64)
     return _load().dds_owner_of(arr.ctypes.data_as(_i64p), len(arr), row)
+
+
+def fault_configure(spec: str, seed: int = 0,
+                    ranks: Optional[Sequence[int]] = None) -> None:
+    """(Re)configure the process-global deterministic fault injector —
+    the runtime equivalent of ``DDSTORE_FAULT_SPEC``/``_SEED``/``_RANKS``.
+
+    ``spec`` is ``kind:probability[:param_ms]`` entries joined by commas
+    (kinds: ``reset``, ``trunc``, ``delay``, ``stall``); an empty spec
+    disables injection. ``ranks`` restricts injection to ops SERVED by
+    those ranks (per-peer fault schedules in shared-process tests).
+    Resets every injector counter including the draw counter, so the
+    same ``(spec, seed)`` replays the same fault schedule."""
+    ranks_csv = ",".join(str(int(r)) for r in ranks) if ranks else ""
+    _check(_load().dds_fault_configure(spec.encode(), int(seed),
+                                       ranks_csv.encode()),
+           f"fault_configure({spec!r})")
+
+
+#: dict keys of :meth:`NativeStore.fault_stats`, in native layout order.
+FAULT_STAT_KEYS = (
+    "fault_checks", "injected_reset", "injected_trunc", "injected_delay",
+    "injected_stall", "injected_delay_ms",
+    "retry_transient", "retry_attempts", "retry_reconnects",
+    "retry_backoff_ms", "retry_giveups", "retry_fatal", "last_error_peer",
+)
 
 
 def _as_i64p(arr: np.ndarray):
@@ -419,6 +457,18 @@ class NativeStore:
         from .utils.metrics import plan_stats_delta
 
         return plan_stats_delta({}, raw)
+
+    def fault_stats(self) -> dict:
+        """Fault-injection + transient-retry counters: the process-global
+        injector's draws/injections (``fault_checks``/``injected_*``) plus
+        THIS handle's retry layer (``retry_*`` — TCP leaf retries and the
+        store-level layer summed, monotone since store creation;
+        ``last_error_peer`` names the most recent failed target, -1 =
+        none). A seeded schedule reproduces these counters exactly across
+        identical runs — the determinism the chaos tests pin."""
+        arr = (ctypes.c_int64 * 16)()
+        _check(self._lib.dds_fault_stats(self._h, arr), "fault_stats")
+        return dict(zip(FAULT_STAT_KEYS, list(arr)[:len(FAULT_STAT_KEYS)]))
 
     @property
     def rank(self) -> int:

@@ -27,6 +27,7 @@ from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
+from ..binding import ERR_PEER_LOST, DDStoreError
 from ..utils.metrics import PipelineMetrics
 from ..utils.profile import annotate
 
@@ -152,6 +153,11 @@ class DeviceLoader:
         store = getattr(dataset, "store", None)
         if store is not None and hasattr(store, "plan_stats"):
             self.metrics.set_plan_source(store.plan_stats)
+        if store is not None and hasattr(store, "fault_stats"):
+            # Epoch summaries carry the fault/retry ledger next to the
+            # plan view: summary()["faults"] is how a chaos run proves
+            # "faults absorbed, zero give-ups" from the record alone.
+            self.metrics.set_fault_source(store.fault_stats)
         if mesh is not None and jax is None:  # pragma: no cover
             raise RuntimeError("jax unavailable but mesh given")
         # `spec` overrides the default leading-dim-over-`axis` layout, e.g.
@@ -192,6 +198,15 @@ class DeviceLoader:
         self._ra_ring = None
         if self.readahead_windows > 0:
             self._readahead_ready = self._readahead_usable()
+        # Mid-epoch degradation latch: once a readahead window fails
+        # even its per-batch retry (a TRANSIENT failure — permanent
+        # owner death raises instead), every worker of this epoch stops
+        # consulting the engine and falls back to per-batch fetch. Reset
+        # per epoch — a fresh engine gets a fresh chance. The lock makes
+        # the latch-and-count a single step (racing workers must not
+        # double-count the degradation event).
+        self._ra_degraded = threading.Event()
+        self._ra_degrade_mu = threading.Lock()
 
     def _readahead_usable(self) -> bool:
         store = getattr(self.dataset, "store", None)
@@ -320,7 +335,20 @@ class DeviceLoader:
                 return
             yield np.asarray(idx, dtype=np.int64)
 
+    def _degrade_readahead(self, e: BaseException) -> None:
+        """Latch the per-epoch readahead degradation (idempotent across
+        racing workers — first failure wins) and record the reason
+        chain."""
+        with self._ra_degrade_mu:
+            if self._ra_degraded.is_set():
+                return
+            self._ra_degraded.set()
+            self.readahead_fallback_reason = f"degraded mid-epoch: {e}"
+            self.metrics.add_fault_event(readahead_degraded=1)
+
     def _fetch(self, idx: np.ndarray, seq: int = 0, ra=None):
+        if ra is not None and self._ra_degraded.is_set():
+            ra = None
         if self._collective_ready:
             try:
                 return self._fetch_collective(idx, seq, ra)
@@ -329,14 +357,43 @@ class DeviceLoader:
                 # trailing batch with drop_last=False): host path for
                 # this batch only.
                 pass
+            except DDStoreError as e:
+                # Degradation ladder, collective rung: a TRANSIENT
+                # staging failure (native retries + the engine's window
+                # retry already ran) drops THIS batch to the host path
+                # below. Permanent owner death is fatal — surface it
+                # (it names the dead owner; elastic.recover is next).
+                if e.code == ERR_PEER_LOST:
+                    raise
+                if self.collective_fallback_reason is None:
+                    self.collective_fallback_reason = \
+                        f"degraded mid-epoch: {e}"
+                self.metrics.add_fault_event(collective_batch_fallbacks=1)
+                if ra is not None:
+                    # The engine raised before any window delivery for
+                    # this seq (batch_rows fails before marking
+                    # delivered), so the host path must not consult it
+                    # either — it would re-raise the same error.
+                    self._degrade_readahead(e)
+                    ra = None
         with self.metrics.fetch.timed(), annotate("ddstore:fetch"):
+            batch = None
             if ra is not None:
-                # Window delivery: an in-RAM gather from the staged
-                # window (the engine recorded the transport-side bytes
-                # once per window, dedup included — no per-batch DCN
-                # accounting here).
-                batch = ra.get_batch(seq, idx=idx)
-            else:
+                try:
+                    # Window delivery: an in-RAM gather from the staged
+                    # window (the engine recorded the transport-side
+                    # bytes once per window, dedup included — no
+                    # per-batch DCN accounting here).
+                    batch = ra.get_batch(seq, idx=idx)
+                except DDStoreError as e:
+                    # Ladder, readahead rung: transient window failure
+                    # that survived the engine's own per-batch retry —
+                    # the rest of the epoch runs per-batch. Fatal codes
+                    # surface.
+                    if e.code == ERR_PEER_LOST:
+                        raise
+                    self._degrade_readahead(e)
+            if batch is None:
                 batch = (self.dataset(idx) if callable(self.dataset)
                          else self.dataset.fetch(idx))
                 self._record_host_dcn(idx)
@@ -383,6 +440,7 @@ class DeviceLoader:
         # engine's close() releases every in-flight async read, so a
         # subsequent store teardown can't race either.
         self.metrics.epoch_start()
+        self._ra_degraded.clear()  # fresh epoch, fresh engine, fresh chance
         ex = ThreadPoolExecutor(max_workers=self.workers,
                                 thread_name_prefix="ddstore-loader")
         futs = deque()

@@ -40,6 +40,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..binding import ERR_PEER_LOST, ERR_TRANSPORT, DDStoreError
+
 __all__ = ["WindowPlan", "plan_window", "plan_epoch_windows",
            "EpochReadahead"]
 
@@ -402,7 +404,41 @@ class EpochReadahead:
             if win.ready.is_set():
                 return
             t0 = time.monotonic()
-            done_ts = win.t_issue
+            try:
+                done_ts = self._wait_window(win)
+            except DDStoreError as e:
+                if e.code not in (ERR_TRANSPORT, ERR_PEER_LOST):
+                    # Data error (out of range, missing var): retrying
+                    # cannot fix it. Latch so every consumer fails fast.
+                    with self._mu:
+                        self._error = e
+                        self._cond.notify_all()
+                    raise
+                # Degraded mode: the bulk window fetch failed after the
+                # native layer's own retries — retry ONCE at per-batch
+                # granularity (smaller requests, fresh native retry
+                # budget per chunk) before surfacing.
+                try:
+                    done_ts = self._refetch_window(win)
+                except DDStoreError as e2:
+                    with self._mu:
+                        self._error = e2
+                        self._cond.notify_all()
+                    raise
+            t1 = time.monotonic()
+            self._account(win, stall_s=t1 - t0,
+                          idle_s=max(0.0, t0 - done_ts),
+                          fetch_s=max(0.0, done_ts - win.t_issue))
+            win.ready.set()
+
+    def _wait_window(self, win: _Window) -> float:
+        """Wait out every variable's window fetch; returns the latest
+        completion timestamp. On ANY failure every still-pending native
+        ticket is released before the error propagates (``async_pending``
+        contributed by this window is 0 afterwards — no worker is left
+        writing into a ring buffer the retry path is about to refill)."""
+        done_ts = win.t_issue
+        try:
             for v in self._vars:
                 if self._ragged[v]:
                     (values, lens), ts = win.futures[v].result()
@@ -415,11 +451,52 @@ class EpochReadahead:
                     h.wait()  # fills the ring buffer, releases the ticket
                     if h.done_mono_s:
                         done_ts = max(done_ts, h.done_mono_s)
-            t1 = time.monotonic()
-            self._account(win, stall_s=t1 - t0,
-                          idle_s=max(0.0, t0 - done_ts),
-                          fetch_s=max(0.0, done_ts - win.t_issue))
-            win.ready.set()
+            return done_ts
+        except BaseException:
+            for h in win.handles.values():
+                h.release()  # idempotent; blocks until the worker is out
+            # Ragged futures are the same hazard in executor form: an
+            # orphaned in-flight window fetch would keep hammering the
+            # (possibly faulty) peers concurrently with the retry's
+            # fresh fetch. Await them too; their own errors are
+            # subsumed by the one propagating.
+            for f in win.futures.values():
+                try:
+                    f.result()
+                except BaseException:  # noqa: BLE001
+                    pass
+            raise
+
+    def _refetch_window(self, win: _Window) -> float:
+        """Per-batch-granularity retry of a transiently failed window:
+        re-fetch every variable's sorted row list in ``n_batches``
+        synchronous chunks straight into the staging buffers. A chunk
+        failure propagates (already classified/augmented by the store
+        layer — kErrPeerLost names the dead owner and the lost rows)."""
+        m = self.metrics
+        if m is not None and hasattr(m, "add_fault_event"):
+            m.add_fault_event(windows_retried=1)
+        rows = win.plan.rows
+        nchunks = max(1, win.plan.n_batches)
+        refetches = 0
+        for v in self._vars:
+            if self._ragged[v]:
+                (values, lens), _ = self._fetch_ragged(v, rows)
+                offs = np.concatenate(
+                    ([0], np.cumsum(lens))).astype(np.int64)
+                win.ragged[v] = (values, lens, offs)
+                refetches += 1
+                continue
+            buf = win.bufs[v]
+            for span in np.array_split(np.arange(rows.size), nchunks):
+                if span.size == 0:
+                    continue
+                lo, hi = int(span[0]), int(span[-1]) + 1
+                self.store.get_batch(v, rows[lo:hi], out=buf[lo:hi])
+                refetches += 1
+        if m is not None and hasattr(m, "add_fault_event"):
+            m.add_fault_event(window_batch_refetches=refetches)
+        return time.monotonic()
 
     def _account(self, win: _Window, stall_s: float, idle_s: float,
                  fetch_s: float) -> None:

@@ -11,6 +11,7 @@
 #include <string>
 #include <vector>
 
+#include "fault.h"
 #include "local_transport.h"
 #include "store.h"
 #include "tcp_transport.h"
@@ -220,6 +221,43 @@ int dds_plan_stats(dds_handle* h, int64_t out[8]) {
   out[5] = s.dedup_hits;
   out[6] = s.scratch_runs;
   out[7] = s.scratch_bytes;
+  return dds::kOk;
+}
+
+// Reconfigure the process-global deterministic fault injector (tests
+// script per-run schedules without env plumbing; resets every injector
+// counter including the draw counter, so the same seed replays the same
+// schedule). Empty/NULL spec disables injection.
+int dds_fault_configure(const char* spec, uint64_t seed,
+                        const char* ranks_csv) {
+  return dds::FaultInjector::Get().Configure(spec ? spec : "", seed,
+                                             ranks_csv ? ranks_csv : "");
+}
+
+// Fault/retry observability snapshot. `out` receives:
+//   [0..5]  process-global injector counters: checks, reset, trunc,
+//           delay, stall, injected_delay_ms
+//   [6..11] retry counters for THIS handle (store-level layer + TCP
+//           leaf layer summed): transient, retries, reconnects,
+//           backoff_ms, giveups, fatal
+//   [12]    last_error_peer (most recent failed target; -1 = none —
+//           the TCP layer's wins when both are set)
+//   [13..15] reserved (0)
+int dds_fault_stats(dds_handle* h, int64_t out[16]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  for (int i = 0; i < 16; ++i) out[i] = 0;
+  dds::FaultInjector::Stats fi = dds::FaultInjector::Get().stats();
+  out[0] = fi.checks;
+  out[1] = fi.reset;
+  out[2] = fi.trunc;
+  out[3] = fi.delay;
+  out[4] = fi.stall;
+  out[5] = fi.delay_ms;
+  int64_t st[7], tc[7] = {0, 0, 0, 0, 0, 0, -1};
+  h->store->RetryCounters(st);
+  if (h->tcp) h->tcp->RetryCounters(tc);
+  for (int i = 0; i < 6; ++i) out[6 + i] = st[i] + tc[i];
+  out[12] = tc[6] >= 0 ? tc[6] : st[6];
   return dds::kOk;
 }
 

@@ -1,0 +1,172 @@
+// Deterministic fault injection + transient-retry policy for the native
+// transports.
+//
+// The reference's only failure handling is exit(1)/throw (SURVEY §5), and
+// its libfabric path retries -EAGAIN unboundedly (common.cxx:332-343); our
+// tree bounded every wait, but until this layer there was no way to even
+// PROVOKE the failure paths in tests. The injector lets a test (or a chaos
+// bench phase) script connection resets, truncated responses, delays, and
+// serve-loop stalls at op granularity, deterministically:
+//
+//   DDSTORE_FAULT_SPEC="reset:0.01,trunc:0.005,delay:0.02:50,stall:0.002"
+//   DDSTORE_FAULT_SEED=42
+//   DDSTORE_FAULT_RANKS=1,3        (optional: inject only when these ranks
+//                                   serve — per-peer schedules in shared-
+//                                   process ThreadGroup tests)
+//
+// Each spec entry is kind:probability[:param_ms]. Decisions are a pure
+// function of (seed, draw counter): hash draw n with splitmix64 and walk
+// the cumulative probability table, so two runs issuing the same request
+// sequence produce byte-identical fault schedules AND counters — the
+// property the retry-metrics regression test pins. Compiled in always;
+// zero-cost when no spec is set (one relaxed atomic load per op).
+
+#ifndef DDSTORE_TPU_FAULT_H_
+#define DDSTORE_TPU_FAULT_H_
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace dds {
+
+enum class FaultKind : int {
+  kNone = 0,
+  kReset,   // shut the connection down before responding (ECONNRESET/EOF)
+  kTrunc,   // send a truncated response frame, then shut down
+  kDelay,   // sleep param_ms before serving (latency, no error)
+  kStall,   // sleep param_ms (default 2000) — long enough to trip the
+            // client's DDSTORE_READ_TIMEOUT_S in chaos tests
+};
+
+struct FaultDecision {
+  FaultKind kind = FaultKind::kNone;
+  int param_ms = 0;
+};
+
+class FaultInjector {
+ public:
+  // Process-global instance. First call parses DDSTORE_FAULT_SPEC /
+  // DDSTORE_FAULT_SEED / DDSTORE_FAULT_RANKS; Configure() overrides at
+  // runtime (tests script per-run schedules without subprocess env
+  // plumbing).
+  static FaultInjector& Get();
+
+  // Hot-path gate: false (one relaxed load) when no spec is configured.
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  // Replace the schedule and reset every counter (including the draw
+  // counter, so the same seed replays the same schedule). Empty spec
+  // disables injection. ranks_csv: empty = inject on every rank.
+  // Returns 0, or kErrInvalidArg on a malformed spec.
+  int Configure(const std::string& spec, uint64_t seed,
+                const std::string& ranks_csv = "");
+
+  // One decision for an op served by `rank`. Ranks outside the filter
+  // short-circuit WITHOUT consuming a draw (the filtered schedule stays
+  // deterministic regardless of what other ranks serve).
+  FaultDecision Draw(int rank);
+
+  struct Stats {
+    int64_t checks = 0;    // draws consumed
+    int64_t reset = 0;
+    int64_t trunc = 0;
+    int64_t delay = 0;
+    int64_t stall = 0;
+    int64_t delay_ms = 0;  // total injected sleep (delay + stall)
+  };
+  Stats stats() const;
+
+ private:
+  FaultInjector();
+
+  struct Rule {
+    FaultKind kind;
+    uint64_t cum;  // cumulative probability threshold in 2^64 space
+    int param_ms;
+  };
+
+  mutable std::mutex mu_;  // guards rules_/ranks_/seed_ (reconfiguration)
+  std::vector<Rule> rules_;
+  std::vector<int> ranks_;  // empty = all ranks
+  uint64_t seed_ = 0;
+  std::atomic<bool> enabled_{false};
+  std::atomic<uint64_t> n_{0};  // draw counter
+  std::atomic<int64_t> c_checks_{0}, c_reset_{0}, c_trunc_{0}, c_delay_{0},
+      c_stall_{0}, c_delay_ms_{0};
+};
+
+// -- transient-retry policy --------------------------------------------------
+//
+// Error classification: a transport-level failure (connection reset,
+// truncated frame, EAGAIN read timeout, failed dial) is TRANSIENT — a
+// reconnect-and-retry can save the op. Server-reported data errors
+// (kErrNotFound/kErrOutOfRange/kErrInvalidArg) are FATAL: the bytes do not
+// exist and retrying cannot make them. Exhausting the retry budget
+// reclassifies the op as kErrPeerLost (see store.h) — the bounded "owner
+// is gone" signal elastic.recover keys on.
+
+struct RetryPolicy {
+  int max_retries;    // DDSTORE_RETRY_MAX   (default 3; 0 = no retry)
+  long base_ms;       // DDSTORE_RETRY_BASE_MS (default 50)
+  double deadline_s;  // DDSTORE_OP_DEADLINE_S (default 300): no NEW
+                      // attempt starts after this much wall time; the
+                      // worst case is deadline + one attempt's own
+                      // connect/read timeouts.
+  static RetryPolicy FromEnv();
+};
+
+// Backoff for retry `attempt` (0-based): base_ms << attempt, capped at
+// 2 s, plus deterministic jitter derived from (seed, attempt) so
+// concurrent leaves don't thundering-herd a recovering peer. Jitter
+// affects timing only — never the fault/retry counters.
+long BackoffMs(const RetryPolicy& pol, int attempt, uint64_t salt);
+
+// Per-component retry/reconnect accounting (one instance in TcpTransport
+// for leaf-level retries, one in Store for the store-level layer that
+// covers transports without internal retry). Monotone since creation.
+struct RetryStats {
+  std::atomic<int64_t> transient{0};   // transient-classified failures
+  std::atomic<int64_t> retries{0};     // retry attempts issued
+  std::atomic<int64_t> reconnects{0};  // lanes redialed by retries
+  std::atomic<int64_t> backoff_ms{0};  // total backoff slept
+  std::atomic<int64_t> giveups{0};     // budgets exhausted -> kErrPeerLost
+  std::atomic<int64_t> fatal{0};       // fatal-classified failures
+  std::atomic<int64_t> last_peer{-1};  // target of the most recent failure
+
+  void Snapshot(int64_t out[7]) const {
+    out[0] = transient.load();
+    out[1] = retries.load();
+    out[2] = reconnects.load();
+    out[3] = backoff_ms.load();
+    out[4] = giveups.load();
+    out[5] = fatal.load();
+    out[6] = last_peer.load();
+  }
+};
+
+// Interruptible sleep for injected delays/stalls and retry backoff:
+// sleeps in <=50 ms slices so teardown (`stop`) never waits out a long
+// stall. `stop` may be null.
+void FaultSleepMs(long ms, const std::atomic<bool>* stop);
+
+// THE transient-retry loop, shared by the TCP leaf layer and the
+// Store-level layer so classification/backoff/counter policy cannot
+// drift between them. Runs `attempt` until success, a fatal
+// (non-kErrTransport) error, or budget exhaustion (RetryPolicy::FromEnv,
+// reclassified kErrPeerLost). `on_retry`, when set, runs just before
+// each re-attempt (the TCP layer counts lane redials there). `target`
+// (-1 = unknown) feeds stats.last_peer. Teardown (`stop` set) aborts
+// with plain kErrTransport — a self-inflicted shutdown must not bump
+// giveups or read as a dead peer.
+int RetryTransientLoop(RetryStats& stats, int target,
+                       const std::atomic<bool>* stop, uint64_t salt,
+                       const std::function<int()>& attempt,
+                       const std::function<void()>& on_retry = {});
+
+}  // namespace dds
+
+#endif  // DDSTORE_TPU_FAULT_H_

@@ -69,10 +69,42 @@ void LocalTransport::Attach(Store* store) { group_->Register(rank_, store); }
 
 LocalTransport::~LocalTransport() { group_->Unregister(rank_); }
 
+namespace {
+// Fault injection for the in-process backend (DDSTORE_FAULT_SPEC): there
+// is no wire to reset here, so reset/trunc/stall all degrade to "this
+// read transiently failed" (kErrTransport — absorbed by the Store's
+// retry layer, since this transport has no internal retry; stall fails
+// WITHOUT sleeping — there is no client timeout to trip on the local
+// path, and an uninterruptible 2 s sleep would only serialize the
+// consumer); delay serves late. One draw per transport call, same
+// determinism contract as the TCP serve loop.
+int DrawLocalFault(int rank) {
+  FaultInjector& fi = FaultInjector::Get();
+  if (!fi.enabled()) return kOk;
+  const FaultDecision d = fi.Draw(rank);
+  switch (d.kind) {
+    case FaultKind::kReset:
+    case FaultKind::kTrunc:
+    case FaultKind::kStall:
+      return kErrTransport;
+    case FaultKind::kDelay:
+      FaultSleepMs(d.param_ms, nullptr);
+      break;
+    case FaultKind::kNone:
+      break;
+  }
+  return kOk;
+}
+}  // namespace
+
 int LocalTransport::Read(int target, const std::string& name, int64_t offset,
                          int64_t nbytes, void* dst) {
   Store* peer = group_->member(target);
   if (!peer) return kErrTransport;
+  // Drawn as the TARGET rank: the injected fault models the PEER's serve
+  // path failing, matching the TCP side (and the DDSTORE_FAULT_RANKS
+  // filter's "inject when these ranks serve" semantics).
+  if (int rc = DrawLocalFault(target)) return rc;
   // ReadLocal holds the peer's read lock across the copy, so a concurrent
   // FreeVar on the peer cannot free the shard mid-read.
   return peer->ReadLocal(name, offset, nbytes, dst);
@@ -84,6 +116,7 @@ int LocalTransport::ReadV(int target, const std::string& name,
   // (the base-class default would pay both per op).
   Store* peer = group_->member(target);
   if (!peer) return kErrTransport;
+  if (int rc = DrawLocalFault(target)) return rc;
   return peer->ReadLocalV(name, ops, n);
 }
 

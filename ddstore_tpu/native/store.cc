@@ -32,6 +32,8 @@ const char* ErrorString(int code) {
     case kErrExists: return "variable already exists";
     case kErrNoMem: return "out of memory";
     case kErrShapeMismatch: return "shape mismatch across ranks";
+    case kErrPeerLost: return "peer unreachable (transient-retry budget "
+                              "exhausted; owner presumed dead)";
     default: return "unknown error";
   }
 }
@@ -160,7 +162,11 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
   int64_t offset = (start - shard_begin) * v.row_bytes();
   int64_t nbytes = count * v.row_bytes();
   if (target == rank()) return ReadLocal(name, offset, nbytes, dst);
-  return transport_->Read(target, name, offset, nbytes, dst);
+  return RetryTransient(
+      [&]() {
+        return transport_->Read(target, name, offset, nbytes, dst);
+      },
+      target);
 }
 
 namespace {
@@ -335,8 +341,18 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
     for (auto& kv : by_peer)
       reqs.push_back(PeerReadV{kv.first, kv.second.data(),
                                static_cast<int64_t>(kv.second.size())});
-    int rc = transport_->ReadVMulti(name, reqs.data(),
-                                    static_cast<int64_t>(reqs.size()));
+    // Transient failures are retried (store-level for transports without
+    // internal retry; the TCP transport retries per leaf). Retries are
+    // idempotent: every op rewrites its own dst/scratch span. Fatal
+    // errors return here — the scratch block and any launched local
+    // task are released on every path (unique_ptr + the Wait below).
+    const int target = reqs.size() == 1 ? reqs[0].target : -1;
+    int rc = RetryTransient(
+        [&]() {
+          return transport_->ReadVMulti(name, reqs.data(),
+                                        static_cast<int64_t>(reqs.size()));
+        },
+        target);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
       return rc;
@@ -360,6 +376,18 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
 PlanStats Store::plan_stats() const {
   std::lock_guard<std::mutex> lock(stats_mu_);
   return stats_;
+}
+
+void Store::RetryCounters(int64_t out[7]) const { retry_.Snapshot(out); }
+
+int Store::RetryTransient(const std::function<int()>& call, int target) {
+  // A self-retrying transport (TCP) already classified the failure —
+  // kErrTransport from it means "fatal before any wire attempt"
+  // (endpoint table not set), not a retryable transient. Avoids
+  // multiplying the two layers' budgets.
+  if (transport_->RetriesInternally()) return call();
+  return RetryTransientLoop(retry_, target, /*stop=*/nullptr,
+                            static_cast<uint64_t>(target + 1), call);
 }
 
 int64_t Store::SubmitAsync(std::function<int()> fn) {
@@ -465,8 +493,13 @@ int Store::ReadRuns(const std::string& name, char* dst,
     for (auto& kv : by_peer)
       reqs.push_back(PeerReadV{kv.first, kv.second.data(),
                                static_cast<int64_t>(kv.second.size())});
-    int rc = transport_->ReadVMulti(name, reqs.data(),
-                                    static_cast<int64_t>(reqs.size()));
+    const int target = reqs.size() == 1 ? reqs[0].target : -1;
+    int rc = RetryTransient(
+        [&]() {
+          return transport_->ReadVMulti(name, reqs.data(),
+                                        static_cast<int64_t>(reqs.size()));
+        },
+        target);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
       return rc;

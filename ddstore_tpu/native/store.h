@@ -29,6 +29,8 @@
 #include <string>
 #include <vector>
 
+#include "fault.h"
+
 namespace dds {
 
 // Error codes returned by every fallible API. Negative values are errors.
@@ -42,7 +44,10 @@ enum ErrorCode : int {
   kErrTransport = -6,    // remote read / barrier failed
   kErrExists = -7,       // variable already registered
   kErrNoMem = -8,        // allocation failure
-  kErrShapeMismatch = -9 // disp/itemsize disagree across ranks
+  kErrShapeMismatch = -9,// disp/itemsize disagree across ranks
+  kErrPeerLost = -10     // transient-retry budget exhausted against one
+                         // peer: the bounded "owner is gone" signal
+                         // (fatal — invoke elastic.recover, do not retry)
 };
 
 const char* ErrorString(int code);
@@ -104,6 +109,13 @@ class WorkerPool;
 class Transport {
  public:
   virtual ~Transport() = default;
+
+  // True when the transport classifies and retries transient failures
+  // itself (the TCP transport's per-leaf reconnect-and-retry). The Store
+  // adds its own bounded retry layer around transports that return false
+  // (the in-process transport under fault injection), so every backend
+  // gets the same transient/fatal contract without double-retrying.
+  virtual bool RetriesInternally() const { return false; }
 
   // Persistent background workers, when the transport keeps any (the TCP
   // transport's pool). The Store borrows them to overlap its local-copy
@@ -241,6 +253,12 @@ class Store {
   // Snapshot of the cumulative scatter-read planner statistics.
   PlanStats plan_stats() const;
 
+  // Store-level transient-retry counters (engaged only for transports
+  // without internal retry; see Transport::RetriesInternally). Layout:
+  // [transient, retries, reconnects, backoff_ms, giveups, fatal,
+  // last_peer].
+  void RetryCounters(int64_t out[7]) const;
+
   // -- async batched reads ------------------------------------------------
   //
   // The epoch-readahead engine's native leg: issue a GetBatch in the
@@ -361,6 +379,12 @@ class Store {
                   int64_t disp, int64_t itemsize, const int64_t* all_nrows,
                   bool copy, bool zero_fill);
 
+  // Bounded transient-retry wrapper around one transport call (Get's
+  // single read, GetBatch/ReadRuns' ReadVMulti). No-op passthrough when
+  // the transport retries internally. `target` names the peer for the
+  // last_peer diagnostic; -1 = multi-peer/unknown.
+  int RetryTransient(const std::function<int()>& call, int target);
+
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
   mutable std::shared_mutex mu_;
@@ -374,6 +398,9 @@ class Store {
   // mutex is fine — one lock per batch, not per row).
   mutable std::mutex stats_mu_;
   PlanStats stats_;
+
+  // Store-level transient-retry accounting (see RetryTransient).
+  RetryStats retry_;
 
   // Async batched-read engine. The completion state is shared_ptr'd so a
   // worker finishing after Release (or ~Store's drain) never touches a

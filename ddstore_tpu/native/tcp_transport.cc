@@ -598,6 +598,41 @@ void TcpTransport::HandleConnection(int fd) {
     name.resize(req.name_len);
     if (req.name_len && rd.Read(&name[0], req.name_len) != 0) return;
 
+    // Deterministic fault injection (DDSTORE_FAULT_SPEC), data reads
+    // only: barrier/CmaInfo frames stay clean — the control plane has
+    // no retry story, and chaos tests target the read paths. One draw
+    // per request frame, so a single-threaded request sequence maps to
+    // one reproducible fault schedule.
+    if ((req.op == kOpRead || req.op == kOpReadVec)) {
+      FaultInjector& fi = FaultInjector::Get();
+      if (fi.enabled()) {
+        const FaultDecision fdec = fi.Draw(rank_);
+        if (fdec.kind == FaultKind::kReset) {
+          // Drop the connection before responding: the client's recv
+          // sees EOF/ECONNRESET immediately (shutdown, not just return
+          // — a merely-abandoned fd would park the client on its full
+          // read timeout instead of a fast reset).
+          ::shutdown(fd, SHUT_RDWR);
+          return;
+        }
+        if (fdec.kind == FaultKind::kTrunc) {
+          // Truncated response frame: half a header, then hard-close.
+          WireResp junk{kOk, 0, 0};
+          FullSend(fd, &junk, sizeof(junk) / 2);
+          ::shutdown(fd, SHUT_RDWR);
+          return;
+        }
+        if (fdec.kind == FaultKind::kDelay ||
+            fdec.kind == FaultKind::kStall) {
+          // Delay serves late (latency chaos); stall (default 2 s)
+          // is meant to outlive a test's DDSTORE_READ_TIMEOUT_S so the
+          // client times out, resets the lane, and retries. Sliced
+          // sleep: teardown must not wait out a stall.
+          FaultSleepMs(fdec.param_ms, &stopping_);
+        }
+      }
+    }
+
     if (req.op == kOpBarrier) {
       // One-way: no response. An acked design deadlocks at teardown — a
       // rank that passes the barrier may close before acking, failing the
@@ -1033,6 +1068,34 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
   return kOk;
 }
 
+int TcpTransport::ReadVOnRetry(Peer& p, Conn& c, const std::string& name,
+                               const ReadOp* ops, int64_t n, int target) {
+  // Transport-level failures (connection reset, truncated frame, read
+  // timeout, failed dial) are transient: a reconnect-and-retry can save
+  // the op — ReadVOn resets the lane on failure and EnsureConnected
+  // redials on the next attempt, so retries are idempotent (every op
+  // rewrites its own dst span; a failed pipelined frame resets the
+  // connection so no stale response can be consumed as fresh data).
+  // Classification/backoff/counter policy lives in RetryTransientLoop,
+  // shared with the Store-level layer.
+  const int rc = RetryTransientLoop(
+      retry_, target, &stopping_,
+      static_cast<uint64_t>(target) * 0x9e3779b97f4a7c15ULL +
+          static_cast<uint64_t>(c.idx),
+      [&]() { return ReadVOn(p, c, name, ops, n); },
+      [&]() {
+        // The failed attempt closed the lane; this retry's
+        // EnsureConnected redials it (racy unlocked peek — a counter,
+        // not an invariant).
+        if (c.fd < 0)
+          retry_.reconnects.fetch_add(1, std::memory_order_relaxed);
+      });
+  if (rc == kErrPeerLost && DebugOn())
+    std::fprintf(stderr, "[dds r%d] read to r%d exhausted retry budget "
+                 "-> peer lost\n", rank_, target);
+  return rc;
+}
+
 // A single TCP stream can't saturate loopback or a DCN NIC. Large requests
 // are split into ~kStripeBytes pieces and the op list is partitioned
 // round-robin by bytes across the peer's connection pool; each pool member
@@ -1382,6 +1445,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   struct Leaf {
     Peer* p;
     Conn* c;
+    int target;  // peer rank, for retry classification/diagnostics
     std::vector<ReadOp> ops;
   };
   std::vector<Leaf> leaves;
@@ -1426,7 +1490,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     }
     if (nconn <= 1 ||
         (total < 2 * kStripeBytes && rq.n < 2 * nconn)) {
-      leaves.push_back(Leaf{&p, p.conns[0].get(),
+      leaves.push_back(Leaf{&p, p.conns[0].get(), rq.target,
                             std::vector<ReadOp>(rq.ops, rq.ops + rq.n)});
       continue;
     }
@@ -1437,7 +1501,8 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
         DealChunks(rq.ops, rq.n, kStripeBytes, nconn);
     for (int ci = 0; ci < nconn; ++ci)
       if (!lists[ci].empty())
-        leaves.push_back(Leaf{&p, p.conns[ci].get(), std::move(lists[ci])});
+        leaves.push_back(Leaf{&p, p.conns[ci].get(), rq.target,
+                              std::move(lists[ci])});
   }
   if (leaves.empty()) return kOk;
 
@@ -1449,12 +1514,14 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     Leaf* lf = &leaves[li];
     int* rc = &rcs[li];
     group.Launch([this, lf, &name, rc]() {
-      *rc = ReadVOn(*lf->p, *lf->c, name, lf->ops.data(),
-                    static_cast<int64_t>(lf->ops.size()));
+      *rc = ReadVOnRetry(*lf->p, *lf->c, name, lf->ops.data(),
+                         static_cast<int64_t>(lf->ops.size()), lf->target);
     });
   }
-  rcs[0] = ReadVOn(*leaves[0].p, *leaves[0].c, name, leaves[0].ops.data(),
-                   static_cast<int64_t>(leaves[0].ops.size()));
+  rcs[0] = ReadVOnRetry(*leaves[0].p, *leaves[0].c, name,
+                        leaves[0].ops.data(),
+                        static_cast<int64_t>(leaves[0].ops.size()),
+                        leaves[0].target);
   group.Wait();
   for (int rc : rcs)
     if (rc != kOk) return rc;

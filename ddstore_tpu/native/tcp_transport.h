@@ -146,6 +146,13 @@ class TcpTransport : public Transport {
   // thread spawns — VERDICT round-1 weak #5).
   int ReadVMulti(const std::string& name, const PeerReadV* reqs,
                  int64_t nreqs) override;
+
+  // Every read leaf carries its own bounded reconnect-and-retry (see
+  // ReadVOnRetry); the Store must not add a second layer on top.
+  bool RetriesInternally() const override { return true; }
+  // Leaf-level retry/reconnect counters ([transient, retries, reconnects,
+  // backoff_ms, giveups, fatal, last_peer] — see RetryStats).
+  void RetryCounters(int64_t out[7]) const { retry_.Snapshot(out); }
   // Dissemination barrier: ceil(log2 P) one-way notify rounds per fence
   // (round k: notify rank+2^k, wait for rank-2^k) instead of the round-1
   // flat O(P) notify loop / O(P^2) total messages.
@@ -192,6 +199,12 @@ class TcpTransport : public Transport {
   // The pipelined request/response loop over one connection.
   int ReadVOn(Peer& p, Conn& c, const std::string& name, const ReadOp* ops,
               int64_t n);
+  // ReadVOn + transient classification + bounded exponential-backoff
+  // retry (reconnecting the lane as needed). Transport-level failures
+  // (reset, truncated frame, read timeout) are TRANSIENT; server-reported
+  // data errors are FATAL; an exhausted budget returns kErrPeerLost.
+  int ReadVOnRetry(Peer& p, Conn& c, const std::string& name,
+                   const ReadOp* ops, int64_t n, int target);
   void AcceptLoop(int lfd, bool is_tcp);
   void HandleConnection(int fd);
   // Send one one-way barrier notify for (tag, round) to `target`.
@@ -309,6 +322,9 @@ class TcpTransport : public Transport {
   // socket). The TCP read leg snapshots it around its timed window to
   // detect connect-tainted routing samples.
   std::atomic<int64_t> dials_{0};
+
+  // Leaf-retry accounting (ReadVOnRetry).
+  RetryStats retry_;
 
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,

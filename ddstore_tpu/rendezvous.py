@@ -333,7 +333,15 @@ class FileGroup(ProcessGroup):
             except OSError:
                 pass
             if time.time() > deadline:
-                raise TimeoutError(f"FileGroup: no MARKER at {marker}")
+                # Name the missing peer artifact, matching the TCP
+                # barrier's "waiting for rank k" diagnostics: only rank 0
+                # publishes the marker, so its absence means rank 0 never
+                # started (or a new launch wiped mid-join).
+                raise TimeoutError(
+                    f"FileGroup: waiting on rank 0's MARKER at {marker} "
+                    f"— rank 0 never published the run nonce (not "
+                    f"started, crashed pre-publish, or a different "
+                    f"launch wiped the directory)")
             time.sleep(0.005)
 
     def _publish(self, seq: int, obj: Any) -> None:
@@ -407,8 +415,17 @@ class FileGroup(ProcessGroup):
                     pending.discard(r)
             if pending:
                 if time.time() > deadline:
+                    # Name the exact peer marker files never published —
+                    # the TCP barrier's "waiting for rank k" diagnostic,
+                    # filesystem edition (barrier() rides allgather, so
+                    # barrier timeouts carry this too).
+                    waiting = ", ".join(
+                        f"rank {r} ({self._run}.{seq}.{r}.pkl)"
+                        for r in sorted(pending))
                     raise TimeoutError(
-                        f"FileGroup allgather {seq}: missing ranks {pending}")
+                        f"FileGroup allgather {seq}: timed out after "
+                        f"{self.timeout:.0f}s waiting on {waiting} "
+                        f"in {self.root}")
                 time.sleep(0.005)
                 spins += 1
                 if spins % 50 == 0:

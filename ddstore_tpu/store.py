@@ -19,7 +19,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .binding import DDStoreError, NativeStore
+from .binding import ERR_PEER_LOST, DDStoreError, NativeStore
 from .rendezvous import (ProcessGroup, SingleGroup, ThreadGroup,
                          auto_group)
 
@@ -341,7 +341,11 @@ class DDStore:
         arbitrary index sets."""
         m = self._require(name)
         out = self._check_out(name, m, out, count)
-        self._native.get(name, out, start, count)
+        try:
+            self._native.get(name, out, start, count)
+        except DDStoreError as e:
+            raise self._classify(e, name,
+                                 np.arange(start, start + count)) from None
         return out
 
     def get_batch(self, name: str, indices, out: Optional[np.ndarray] = None
@@ -352,7 +356,10 @@ class DDStore:
         m = self._require(name)
         idx = np.ascontiguousarray(indices, dtype=np.int64).reshape(-1)
         out = self._check_out(name, m, out, len(idx))
-        self._native.get_batch(name, out, idx)
+        try:
+            self._native.get_batch(name, out, idx)
+        except DDStoreError as e:
+            raise self._classify(e, name, idx) from None
         return out
 
     def get_batch_async(self, name: str, indices,
@@ -386,6 +393,33 @@ class DDStore:
     def async_pending(self) -> int:
         """In-flight / unreleased async reads (0 after clean teardown)."""
         return self._native.async_pending
+
+    def _classify(self, e: DDStoreError, name: str,
+                  idx: np.ndarray) -> DDStoreError:
+        """Re-raise helper for failed reads: a permanent owner loss
+        (``ERR_PEER_LOST`` — the bounded signal the native retry layer
+        emits when its budget exhausts against one peer) is augmented
+        with WHICH owner died and WHICH requested rows were lost, so the
+        caller can hand exactly that to ``elastic.recover``. Everything
+        else passes through unchanged."""
+        if e.code != ERR_PEER_LOST:
+            return e
+        peer = int(self._native.fault_stats().get("last_error_peer", -1))
+        lost = idx
+        try:
+            if peer >= 0:
+                owners = self.owner_of_rows(name, idx)
+                lost = idx[owners == peer]
+        except Exception:  # noqa: BLE001 — diagnostics must not mask e
+            pass
+        preview = ", ".join(str(int(r)) for r in lost[:4])
+        more = "..." if len(lost) > 4 else ""
+        err = DDStoreError(
+            e.code,
+            f"{name}: owner rank {peer} unreachable after bounded "
+            f"retries; {len(lost)} requested rows lost "
+            f"(rows {preview}{more}) — invoke elastic.recover")
+        return err
 
     @staticmethod
     def _check_out(name: str, m: "_VarMeta", out: Optional[np.ndarray],
@@ -650,6 +684,14 @@ class DDStore:
         creation; diff two snapshots for a per-epoch view (that is what
         ``DeviceLoader.metrics`` reports)."""
         return self._native.plan_stats()
+
+    def fault_stats(self) -> dict:
+        """Fault-injection and transient-retry counters (see
+        :meth:`NativeStore.fault_stats`): injector draws/injections plus
+        this store's retry/reconnect/backoff/giveup accounting. Monotone;
+        diff snapshots for per-epoch views — ``DeviceLoader.metrics``
+        wires this in as ``summary()["faults"]``."""
+        return self._native.fault_stats()
 
     @property
     def rank(self) -> int:

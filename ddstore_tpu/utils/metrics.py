@@ -117,6 +117,22 @@ class PipelineMetrics:
     WINDOW_KEYS = ("rows_requested", "rows_unique", "dup_rows", "runs",
                    "remote_runs", "peer_lists", "window_bytes")
 
+    #: degraded-mode events accepted by :meth:`add_fault_event` — the
+    #: pipeline-level half of the fault story (the native half comes
+    #: from the fault source):
+    #:   windows_retried          readahead windows re-fetched at
+    #:                            per-batch granularity after a
+    #:                            transient window-fetch failure
+    #:   window_batch_refetches   per-batch refetch requests those
+    #:                            retries issued
+    #:   readahead_degraded       engines abandoned mid-epoch (loader
+    #:                            fell back to per-batch fetch)
+    #:   collective_batch_fallbacks  device-collective batches that fell
+    #:                            back to the host path on a transient
+    #:                            staging failure
+    FAULT_EVENT_KEYS = ("windows_retried", "window_batch_refetches",
+                        "readahead_degraded", "collective_batch_fallbacks")
+
     def __init__(self, plan_source: Optional[Callable[[], Dict]] = None):
         self.wait = LatencyHistogram("device_wait")
         self.fetch = LatencyHistogram("host_fetch")
@@ -142,6 +158,15 @@ class PipelineMetrics:
         self._ra_mu = threading.Lock()
         self._ra: Dict[str, int] = {k: 0 for k in self.WINDOW_KEYS}
         self._ra_windows = 0
+        # Fault accounting: a cumulative-counter source (DDStore.
+        # fault_stats — injector draws + native retry layers) snapshotted
+        # at epoch boundaries, plus pipeline-level degradation events.
+        self._fault_source: Optional[Callable[[], Dict]] = None
+        self._fault_begin: Optional[Dict] = None
+        self._fault_end: Optional[Dict] = None
+        self._fault_mu = threading.Lock()
+        self._fault_events: Dict[str, int] = \
+            {k: 0 for k in self.FAULT_EVENT_KEYS}
         # (bytes, fetch_s) per window, for the honest per-window best
         # bandwidth (bounded: one entry per window, windows are O(epoch
         # batches / W)).
@@ -161,6 +186,53 @@ class PipelineMetrics:
         except Exception:
             # A closed/torn-down store must not sink epoch accounting.
             return None
+
+    def set_fault_source(self, source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning cumulative fault/retry
+        counters (``DDStore.fault_stats``). Snapshotted at epoch
+        boundaries; ``summary()["faults"]`` reports the per-epoch delta
+        alongside the pipeline's own degradation events."""
+        self._fault_source = source
+
+    def _snap_faults(self) -> Optional[Dict]:
+        if self._fault_source is None:
+            return None
+        try:
+            return dict(self._fault_source())
+        except Exception:
+            return None
+
+    def add_fault_event(self, **counters: int) -> None:
+        """Fold pipeline-level degraded-mode events into the epoch totals
+        (:data:`FAULT_EVENT_KEYS`; unknown keys are rejected loudly)."""
+        with self._fault_mu:
+            for k, v in counters.items():
+                if k not in self._fault_events:
+                    raise KeyError(f"unknown fault event {k!r}; "
+                                   f"expected one of {self.FAULT_EVENT_KEYS}")
+                self._fault_events[k] += int(v)
+
+    def fault_summary(self) -> Dict:
+        """Per-epoch fault view: native injector/retry counter deltas
+        (when a source is attached) + pipeline degradation events."""
+        out: Dict = {}
+        if self._fault_begin is not None:
+            end = self._fault_end if self._fault_end is not None \
+                else self._snap_faults()
+            if end is not None:
+                for k in end:
+                    if k == "last_error_peer":
+                        out[k] = int(end[k])
+                    else:
+                        # Clamped at 0: fault_configure() mid-epoch
+                        # resets the process-global injector counters
+                        # below the epoch baseline, and a negative
+                        # "injections this epoch" is nonsense.
+                        out[k] = max(0, int(end[k]) - int(
+                            self._fault_begin.get(k, 0)))
+        with self._fault_mu:
+            out.update(self._fault_events)
+        return out
 
     def add_bytes(self, **counters: int) -> None:
         """Fold one fetch's bytes-moved ledger into the epoch totals
@@ -241,12 +313,16 @@ class PipelineMetrics:
         self._t_start = time.perf_counter()
         self._plan_begin = self._snap_plan()
         self._plan_end = None
+        self._fault_begin = self._snap_faults()
+        self._fault_end = None
         with self._bytes_mu:
             self._bytes = {k: 0 for k in self.BYTE_KEYS}
         with self._ra_mu:
             self._ra = {k: 0 for k in self.WINDOW_KEYS}
             self._ra_windows = 0
             self._ra_fetch_samples = []
+        with self._fault_mu:
+            self._fault_events = {k: 0 for k in self.FAULT_EVENT_KEYS}
         self.ra_wait = LatencyHistogram("readahead_consumer_wait")
         self.ra_idle = LatencyHistogram("readahead_producer_idle")
         self.ra_fetch = LatencyHistogram("readahead_window_fetch")
@@ -254,6 +330,7 @@ class PipelineMetrics:
     def epoch_end(self) -> None:
         self._t_end = time.perf_counter()
         self._plan_end = self._snap_plan()
+        self._fault_end = self._snap_faults()
 
     @property
     def total_s(self) -> float:
@@ -288,4 +365,10 @@ class PipelineMetrics:
             out["bytes_moved"] = moved
         if self._ra_windows:
             out["readahead"] = self.readahead_summary()
+        faults = self.fault_summary()
+        # Included whenever a fault source is wired (even all-zero: "no
+        # faults this epoch" is itself the result a chaos A/B reads) or
+        # any degradation event fired.
+        if self._fault_begin is not None or any(faults.values()):
+            out["faults"] = faults
         return out

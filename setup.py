@@ -21,7 +21,10 @@ from setuptools.command.build_py import build_py as _build_py
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 NATIVE = os.path.join(HERE, "ddstore_tpu", "native")
-SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc", "capi.cc"]
+# Keep in sync with ddstore_tpu/_build.py _SOURCES (not imported: pulling
+# in the package here would trigger its lazy native build mid-setup).
+SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
+           "worker_pool.cc", "cma.cc", "fault.cc", "capi.cc"]
 
 
 def compile_native(out_dir: str) -> str:

@@ -73,6 +73,63 @@ ts = [threading.Thread(target=tcp_worker, args=(r, errs)) for r in range(2)]
 [t.start() for t in ts]
 [t.join() for t in ts]
 assert not errs, errs
+
+# Error paths under the sanitizer (ISSUE 4 satellite): a mid-plan
+# GetBatch failure must free its scratch staging, a failed async read
+# must release its ticket, and the fault-injection + transient-retry
+# machinery must not race or leak. These paths only run when something
+# goes wrong, which is exactly when leak/race bugs hide.
+import os
+from ddstore_tpu import DDStoreError, fault_configure
+
+ERRNAME = uuid.uuid4().hex
+
+def err_worker(rank, errs):
+    try:
+        group = ThreadGroup(ERRNAME, rank, 2)
+        with DDStore(group, backend="local") as s:
+            s.add("v", np.full((32, 8), rank + 1, np.float32))
+            if rank == 0:
+                # Mid-plan failure: duplicate + scattered rows force the
+                # scratch/replica machinery, then an out-of-range row
+                # aborts the batch (scratch freed on the error return).
+                bad = np.array([5, 5, 40, 63, 2, 10**9], np.int64)
+                try:
+                    s.get_batch("v", bad)
+                    errs.append((rank, "get_batch accepted bad rows"))
+                except DDStoreError:
+                    pass
+                # Failed ASYNC read must release its ticket on the
+                # error path (wait() raises, release() is the teardown
+                # barrier) — async_pending()==0 is the leak check.
+                h = s.get_batch_async("v", bad)
+                try:
+                    h.wait()
+                    errs.append((rank, "async accepted bad rows"))
+                except DDStoreError:
+                    pass
+                assert s.async_pending() == 0, s.async_pending()
+                # Injected transient faults + bounded retry under the
+                # sanitizer (reset -> kErrTransport -> store-level
+                # backoff/retry).
+                os.environ["DDSTORE_RETRY_BASE_MS"] = "1"
+                fault_configure("reset:0.3", seed=5)
+                try:
+                    for i in range(40):
+                        got = s.get("v", 32 + (i % 32))
+                        assert (got == 2).all()
+                finally:
+                    fault_configure("", 0)
+            s.barrier()
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=err_worker, args=(r, errs))
+      for r in range(2)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
 print("stress ok")
 """
 
