@@ -107,6 +107,14 @@ def _load() -> ctypes.CDLL:
     lib.dds_uds_conns.argtypes = [ctypes.c_void_p]
     lib.dds_plan_stats.restype = ctypes.c_int
     lib.dds_plan_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_lane_state.restype = ctypes.c_int
+    lib.dds_lane_state.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_lane_bytes.restype = ctypes.c_int
+    lib.dds_lane_bytes.argtypes = [ctypes.c_void_p, ctypes.c_int, _i64p,
+                                   ctypes.c_int]
+    lib.dds_set_retry_deadline.restype = ctypes.c_int
+    lib.dds_set_retry_deadline.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_double]
     lib.dds_fault_configure.restype = ctypes.c_int
     lib.dds_fault_configure.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                         ctypes.c_char_p]
@@ -172,6 +180,22 @@ def fault_configure(spec: str, seed: int = 0,
     _check(_load().dds_fault_configure(spec.encode(), int(seed),
                                        ranks_csv.encode()),
            f"fault_configure({spec!r})")
+
+
+#: Default transient-retry deadline seconds when DDSTORE_OP_DEADLINE_S
+#: is unset — keep in sync with the native RetryPolicy default in
+#: fault.cc (the readahead degraded path derives its shared-budget math
+#: from this; drift would silently hand refetches the wrong base).
+DEFAULT_OP_DEADLINE_S = 300.0
+
+
+#: dict keys of :meth:`NativeStore.lane_state`, in native layout order.
+#: ``active_lanes``/``parked``/``best_bw_bytes_per_s`` describe the
+#: bulk-stripe tuner (the headline); the scatter class (many-small-op
+#: dealing) has its own tuner with its own park.
+LANE_STATE_KEYS = ("max_lanes", "active_lanes", "parked", "autotune",
+                   "samples", "best_bw_bytes_per_s",
+                   "scatter_active_lanes", "scatter_parked")
 
 
 #: dict keys of :meth:`NativeStore.fault_stats`, in native layout order.
@@ -267,6 +291,46 @@ class NativeStore:
         # the UDS fast lane or silently fell back to loopback TCP.
         out["uds_conns"] = self._lib.dds_uds_conns(self._h)
         return out
+
+    def set_retry_deadline(self, seconds: float) -> None:
+        """Override THIS store's transient-retry deadline
+        (``DDSTORE_OP_DEADLINE_S``); ``<= 0`` restores the env/default.
+        The degraded readahead path uses it to share ONE deadline
+        budget across a window give-up and its per-batch refetch, so a
+        permanently dead owner surfaces ``kErrPeerLost`` within ~1x the
+        deadline instead of ~2x. Per-store: other stores in the process
+        keep their full budgets; still advisory within this store —
+        concurrent reads on it see the reduced budget while set, so
+        callers must clear it in a ``finally``."""
+        _check(self._lib.dds_set_retry_deadline(self._h, float(seconds)),
+               "set_retry_deadline")
+
+    def lane_state(self) -> dict:
+        """Striped-lane autotuner snapshot (:data:`LANE_STATE_KEYS`):
+        the configured pool size (``DDSTORE_TCP_LANES``), the lane count
+        striped reads currently engage, whether the tuner has parked
+        (per-lane throughput stopped scaling), and the best measured
+        stripe bandwidth. ``{}`` for non-TCP backends."""
+        arr = (ctypes.c_int64 * 8)()
+        if self._lib.dds_lane_state(self._h, arr) != 0:
+            return {}
+        out = dict(zip(LANE_STATE_KEYS, list(arr)[:len(LANE_STATE_KEYS)]))
+        for k in ("parked", "autotune", "scatter_parked"):
+            out[k] = bool(out[k])
+        return out
+
+    def lane_bytes(self, target: int = -1) -> list:
+        """Per-lane response bytes carried over TCP/UDS since store
+        creation (``target >= 0``: that peer's lanes; ``-1``: summed
+        across peers, lane-index-aligned). ``[]`` for non-TCP backends.
+        Monotone; diff snapshots for per-epoch lane utilization — that
+        is what ``PipelineMetrics`` does with its lane source."""
+        cap = 64
+        arr = (ctypes.c_int64 * cap)()
+        n = self._lib.dds_lane_bytes(self._h, int(target), arr, cap)
+        if n < 0:
+            return []
+        return list(arr)[:n]
 
     @property
     def barrier_seq(self) -> int:

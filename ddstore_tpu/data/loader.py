@@ -158,6 +158,12 @@ class DeviceLoader:
             # plan view: summary()["faults"] is how a chaos run proves
             # "faults absorbed, zero give-ups" from the record alone.
             self.metrics.set_fault_source(store.fault_stats)
+        if store is not None and hasattr(store, "lane_bytes"):
+            # Per-lane byte deltas land in summary()["bytes_moved"]
+            # (lane_bytes / tcp_lanes_used / lane_utilization): whether
+            # striped reads actually spread across the lane pool is
+            # diagnosable from the epoch record alone.
+            self.metrics.set_lane_source(store.lane_bytes)
         if mesh is not None and jax is None:  # pragma: no cover
             raise RuntimeError("jax unavailable but mesh given")
         # `spec` overrides the default leading-dim-over-`axis` layout, e.g.

@@ -34,13 +34,15 @@ that is what the bench's readahead A/B phase drives.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..binding import ERR_PEER_LOST, ERR_TRANSPORT, DDStoreError
+from ..binding import (DEFAULT_OP_DEADLINE_S, ERR_PEER_LOST,
+                       ERR_TRANSPORT, DDStoreError)
 
 __all__ = ["WindowPlan", "plan_window", "plan_epoch_windows",
            "EpochReadahead"]
@@ -241,6 +243,15 @@ class EpochReadahead:
 
         self._mu = threading.Lock()
         self._cond = threading.Condition(self._mu)
+        # Serializes degraded-window refetches: each one sets the
+        # store's shared retry-deadline override, and two windows
+        # failing concurrently (depth >= 2 under chaos, out-of-order
+        # loader workers) would otherwise clobber each other's budget
+        # mid-refetch — one window's floor aborting the other's healthy
+        # retry, or one's clear handing the other a fresh full
+        # deadline. Refetches contend for the same faulty peers anyway;
+        # running them one at a time costs nothing correct.
+        self._refetch_mu = threading.Lock()
         self._win: Dict[int, _Window] = {}
         self._next_issue = 0
         # Ring-slot recycling keys on IN-ORDER consumption: concurrent
@@ -416,10 +427,42 @@ class EpochReadahead:
                     raise
                 # Degraded mode: the bulk window fetch failed after the
                 # native layer's own retries — retry ONCE at per-batch
-                # granularity (smaller requests, fresh native retry
-                # budget per chunk) before surfacing.
+                # granularity before surfacing. The refetch shares the
+                # WINDOW's OP_DEADLINE budget rather than getting a
+                # fresh one: against a permanently dead owner the window
+                # give-up already burned ~1x the deadline, and a fresh
+                # per-chunk budget would double the time to the
+                # classified kErrPeerLost raise. Whatever the window
+                # left over (floored so a transient blip still gets a
+                # real retry) is the refetch's whole allowance. The
+                # override is per-STORE (other ranks'/stores' budgets
+                # in this process are untouched) and cleared on every
+                # exit path; stores without the knob (test proxies)
+                # just run the refetch on the full budget.
+                deadline = DEFAULT_OP_DEADLINE_S
                 try:
-                    done_ts = self._refetch_window(win)
+                    deadline = float(
+                        os.environ.get("DDSTORE_OP_DEADLINE_S", "")
+                        or DEFAULT_OP_DEADLINE_S)
+                except ValueError:
+                    pass
+                set_deadline = getattr(self.store, "set_retry_deadline",
+                                       None)
+                try:
+                    with self._refetch_mu:
+                        # Remaining budget computed INSIDE the lock:
+                        # waiting behind another window's refetch is
+                        # part of this window's elapsed time.
+                        elapsed = time.monotonic() - win.t_issue
+                        remaining = max(min(2.0, 0.25 * deadline),
+                                        deadline - elapsed)
+                        try:
+                            if set_deadline is not None:
+                                set_deadline(remaining)
+                            done_ts = self._refetch_window(win)
+                        finally:
+                            if set_deadline is not None:
+                                set_deadline(0.0)
                 except DDStoreError as e2:
                     with self._mu:
                         self._error = e2

@@ -77,6 +77,39 @@ int dds_routing_state(dds_handle* h, int cls, double* cma_bw,
   return dds::kOk;
 }
 
+// Lane (striped-connection) observability. `out` receives
+// [max_lanes, active_lanes, parked, autotune, samples,
+//  best_bw_bytes_per_s, scatter_active_lanes, scatter_parked] —
+// slots 1-5 are the bulk-stripe tuner, 6-7 the scatter-class tuner
+// (keep in sync with TcpTransport::LaneState and binding.py
+// LANE_STATE_KEYS).
+int dds_lane_state(dds_handle* h, int64_t out[8]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  for (int i = 0; i < 8; ++i) out[i] = 0;
+  if (!h->tcp) return dds::kErrInvalidArg;  // lanes are a TCP concept
+  h->tcp->LaneState(out);
+  return dds::kOk;
+}
+
+// Per-lane response bytes (target >= 0: that peer's lanes; -1: summed
+// across peers, lane-aligned). Returns the lane count written into
+// `out` (bounded by cap), or a negative error.
+int dds_lane_bytes(dds_handle* h, int target, int64_t* out, int cap) {
+  if (!h || !out || cap <= 0) return dds::kErrInvalidArg;
+  if (!h->tcp) return dds::kErrInvalidArg;
+  return h->tcp->LaneBytes(target, out, cap);
+}
+
+// Per-store retry-deadline override (seconds; <= 0 clears). The
+// degraded readahead path shares one OP_DEADLINE budget across a
+// window give-up and its per-batch refetch through this; other stores
+// in the process keep their full budgets.
+int dds_set_retry_deadline(dds_handle* h, double seconds) {
+  if (!h) return dds::kErrInvalidArg;
+  h->store->SetRetryDeadline(seconds);
+  return dds::kOk;
+}
+
 int64_t dds_barrier_seq(dds_handle* h) {
   return h && h->tcp ? h->tcp->barrier_seq() : -1;
 }

@@ -177,6 +177,9 @@ FaultInjector::Stats FaultInjector::stats() const {
 }
 
 RetryPolicy RetryPolicy::FromEnv() {
+  // Deadline default: keep in sync with binding.py
+  // DEFAULT_OP_DEADLINE_S (the readahead shared-budget math reads it
+  // Python-side).
   RetryPolicy p{3, 50, 300.0};
   if (const char* env = std::getenv("DDSTORE_RETRY_MAX")) {
     char* end = nullptr;
@@ -211,7 +214,8 @@ long BackoffMs(const RetryPolicy& pol, int attempt, uint64_t salt) {
 int RetryTransientLoop(RetryStats& stats, int target,
                        const std::atomic<bool>* stop, uint64_t salt,
                        const std::function<int()>& attempt,
-                       const std::function<void()>& on_retry) {
+                       const std::function<void()>& on_retry,
+                       double deadline_override) {
   int rc = attempt();
   if (rc == kOk) return rc;
   if (rc != kErrTransport) {
@@ -221,7 +225,10 @@ int RetryTransientLoop(RetryStats& stats, int target,
     if (target >= 0) stats.last_peer.store(target);
     return rc;
   }
-  const RetryPolicy pol = RetryPolicy::FromEnv();
+  RetryPolicy pol = RetryPolicy::FromEnv();
+  // The degraded-pipeline budget share (see the header): a refetch
+  // sharing its window's deadline must not be handed a fresh full one.
+  if (deadline_override > 0.0) pol.deadline_s = deadline_override;
   const auto deadline =
       std::chrono::steady_clock::now() +
       std::chrono::duration_cast<std::chrono::steady_clock::duration>(

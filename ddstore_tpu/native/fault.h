@@ -119,6 +119,17 @@ struct RetryPolicy {
   static RetryPolicy FromEnv();
 };
 
+// Deadline override plumbing: the readahead degraded path shares ONE
+// OP_DEADLINE budget across a window give-up and its per-batch refetch
+// — the refetch runs with whatever budget the window's own give-up
+// left over, so a permanently dead owner surfaces kErrPeerLost within
+// ~1x the deadline instead of ~2x. The override is PER STORE (each
+// retry layer holds an atomic consulted by its RetryTransientLoop
+// calls, threaded through the `deadline_override` parameter below): a
+// process-global override would shrink the budget of every other
+// store in the process — in a ThreadGroup sim that spuriously
+// reclassifies a live peer as lost on a rank that was never degraded.
+
 // Backoff for retry `attempt` (0-based): base_ms << attempt, capped at
 // 2 s, plus deterministic jitter derived from (seed, attempt) so
 // concurrent leaves don't thundering-herd a recovering peer. Jitter
@@ -159,13 +170,16 @@ void FaultSleepMs(long ms, const std::atomic<bool>* stop);
 // (non-kErrTransport) error, or budget exhaustion (RetryPolicy::FromEnv,
 // reclassified kErrPeerLost). `on_retry`, when set, runs just before
 // each re-attempt (the TCP layer counts lane redials there). `target`
-// (-1 = unknown) feeds stats.last_peer. Teardown (`stop` set) aborts
-// with plain kErrTransport — a self-inflicted shutdown must not bump
-// giveups or read as a dead peer.
+// (-1 = unknown) feeds stats.last_peer. `deadline_override` (> 0)
+// replaces the policy's deadline_s — the per-store budget-sharing hook
+// above. Teardown (`stop` set) aborts with plain kErrTransport — a
+// self-inflicted shutdown must not bump giveups or read as a dead
+// peer.
 int RetryTransientLoop(RetryStats& stats, int target,
                        const std::atomic<bool>* stop, uint64_t salt,
                        const std::function<int()>& attempt,
-                       const std::function<void()>& on_retry = {});
+                       const std::function<void()>& on_retry = {},
+                       double deadline_override = 0.0);
 
 }  // namespace dds
 

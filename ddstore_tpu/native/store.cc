@@ -18,6 +18,15 @@ double MonoSeconds() {
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
+
+long AsyncThreadsFromEnv() {
+  if (const char* env = std::getenv("DDSTORE_ASYNC_THREADS")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) return v < 16 ? v : 16;
+  }
+  return 2;
+}
 }  // namespace
 
 const char* ErrorString(int code) {
@@ -380,14 +389,23 @@ PlanStats Store::plan_stats() const {
 
 void Store::RetryCounters(int64_t out[7]) const { retry_.Snapshot(out); }
 
+void Store::SetRetryDeadline(double seconds) {
+  retry_deadline_ns_.store(
+      seconds > 0.0 ? static_cast<int64_t>(seconds * 1e9) : 0,
+      std::memory_order_relaxed);
+  transport_->SetRetryDeadline(seconds);
+}
+
 int Store::RetryTransient(const std::function<int()>& call, int target) {
   // A self-retrying transport (TCP) already classified the failure —
   // kErrTransport from it means "fatal before any wire attempt"
   // (endpoint table not set), not a retryable transient. Avoids
   // multiplying the two layers' budgets.
   if (transport_->RetriesInternally()) return call();
-  return RetryTransientLoop(retry_, target, /*stop=*/nullptr,
-                            static_cast<uint64_t>(target + 1), call);
+  return RetryTransientLoop(
+      retry_, target, /*stop=*/nullptr,
+      static_cast<uint64_t>(target + 1), call, /*on_retry=*/{},
+      retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9);
 }
 
 int64_t Store::SubmitAsync(std::function<int()> fn) {
@@ -396,10 +414,15 @@ int64_t Store::SubmitAsync(std::function<int()> fn) {
   {
     std::lock_guard<std::mutex> lock(async_mu_);
     if (!async_pool_) {
-      // 2 threads: one window in flight is the steady state (the ring
-      // keeps window N+1 fetching while N is consumed); the second
-      // absorbs a co-variable (labels) issued alongside.
-      async_pool_.reset(new WorkerPool(2));
+      // Default 2 threads: one window in flight is the steady state
+      // (the ring keeps window N+1 fetching while N is consumed); the
+      // second absorbs a co-variable (labels) issued alongside. Each
+      // async read's lane fan-out happens INSIDE the transport pool, so
+      // this count stays the stripe-scheduling admission width — how
+      // many window reads may contend for lanes at once.
+      // DDSTORE_ASYNC_THREADS raises it for deep (depth > 2) rings.
+      async_pool_.reset(
+          new WorkerPool(static_cast<int>(AsyncThreadsFromEnv())));
     }
     ticket = next_ticket_++;
     async_[ticket] = st;

@@ -184,6 +184,12 @@ class Transport {
                           int64_t nbytes) {}
   virtual void UnpublishVar(const std::string& name) {}
 
+  // Per-transport retry-deadline override (<= 0 clears): transports
+  // with an internal retry layer (TCP leaves) apply it to their own
+  // RetryTransientLoop calls. Default no-op for transports the
+  // Store-level layer covers.
+  virtual void SetRetryDeadline(double seconds) { (void)seconds; }
+
   // Collective tagged barrier across the group. Every rank must issue the
   // same serialized sequence of Barrier calls (matching is positional —
   // the TCP transport pairs barriers by an internal per-transport
@@ -258,6 +264,14 @@ class Store {
   // [transient, retries, reconnects, backoff_ms, giveups, fatal,
   // last_peer].
   void RetryCounters(int64_t out[7]) const;
+
+  // Override THIS store's transient-retry deadline (seconds; <= 0
+  // restores DDSTORE_OP_DEADLINE_S). Applied to the store-level retry
+  // layer and forwarded to the transport's internal one — the degraded
+  // readahead path shares one deadline budget across a window give-up
+  // and its per-batch refetch through this. Per-store by design: other
+  // stores in the process keep their full budgets.
+  void SetRetryDeadline(double seconds);
 
   // -- async batched reads ------------------------------------------------
   //
@@ -401,6 +415,9 @@ class Store {
 
   // Store-level transient-retry accounting (see RetryTransient).
   RetryStats retry_;
+  // Deadline override consulted by RetryTransient (nanos; 0 = none —
+  // int64 atomic: atomic<double> is not universally lock-free).
+  std::atomic<int64_t> retry_deadline_ns_{0};
 
   // Async batched-read engine. The completion state is shared_ptr'd so a
   // worker finishing after Release (or ~Store's drain) never touches a
@@ -424,7 +441,9 @@ class Store {
   mutable std::mutex async_mu_;
   int64_t next_ticket_ = 1;
   std::map<int64_t, std::shared_ptr<AsyncState>> async_;
-  std::unique_ptr<WorkerPool> async_pool_;  // lazily created, 2 threads
+  std::unique_ptr<WorkerPool> async_pool_;  // lazily created;
+  // DDSTORE_ASYNC_THREADS wide (default 2) — the admission width for
+  // concurrent window reads contending for the transport's lanes
 };
 
 }  // namespace dds

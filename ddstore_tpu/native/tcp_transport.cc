@@ -392,11 +392,39 @@ TcpTransport::TcpTransport(int rank, int world, int port)
   }
 
   // Striping only pays when there are cores to run the extra streams and
-  // serving threads (TPU-VM hosts have ~100; CI boxes may have 1).
+  // serving threads (TPU-VM hosts have ~100; CI boxes may have 1). The
+  // lane count defaults from the core count; DDSTORE_TCP_LANES overrides
+  // (DDSTORE_CONNS_PER_PEER is the pre-lane name of the same knob, kept
+  // as a fallback alias so existing deployments keep their setting).
   unsigned hw = std::thread::hardware_concurrency();
   hw_cores_ = hw ? hw : 1;
-  long nconn = EnvLong("DDSTORE_CONNS_PER_PEER", hw >= 8 ? 4 : 1);
+  long nconn = EnvLong(
+      "DDSTORE_TCP_LANES",
+      EnvLong("DDSTORE_CONNS_PER_PEER", hw >= 8 ? 4 : (hw >= 4 ? 2 : 1)));
   if (nconn > 64) nconn = 64;
+  {
+    // Lane autotuners (one per traffic class): measurement levels
+    // 1, 2, 4, ... pool size. A 1-lane pool (or
+    // DDSTORE_TCP_LANES_AUTOTUNE=0) parks immediately at the pool size
+    // — zero measurement overhead, and the 1-lane path stays byte- and
+    // error-code-identical to the pre-lane tree.
+    const char* at = ::getenv("DDSTORE_TCP_LANES_AUTOTUNE");
+    const bool autotune = !at || std::strtol(at, nullptr, 10) != 0;
+    scatter_lanes_.name = "scatter";
+    for (LaneTuner* t : {&bulk_lanes_, &scatter_lanes_}) {
+      t->autotune = autotune;
+      for (int l = 1; l < static_cast<int>(nconn); l *= 2)
+        t->levels.push_back(l);
+      t->levels.push_back(static_cast<int>(nconn));
+      t->bw.assign(t->levels.size(), 0.0);
+      t->n.assign(t->levels.size(), 0);
+      t->warmed.assign(t->levels.size(), false);
+      if (!autotune || nconn <= 1) {
+        t->parked = true;
+        t->active = static_cast<int>(nconn);
+      }
+    }
+  }
   peers_.resize(world_);
   for (int i = 0; i < world_; ++i) {
     peers_[i] = std::make_unique<Peer>();
@@ -545,6 +573,23 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
       // hysteresis band only, re-introducing the parked-inside-the-band
       // cold start for every post-replacement lifetime.
       rc->calibrated = false;
+    }
+  }
+  {
+    // Same story for the lane parks: they were measured against the
+    // old peer set. Re-open both tuners so the replacement lifetime
+    // re-measures (no-op when autotune is off or the pool is 1 lane).
+    std::lock_guard<std::mutex> lock(lane_mu_);
+    for (LaneTuner* t : {&bulk_lanes_, &scatter_lanes_}) {
+      if (t->autotune && t->levels.back() > 1) {
+        t->parked = false;
+        t->level = 0;
+        t->cold_skips = 0;
+        t->samples = 0;
+        std::fill(t->bw.begin(), t->bw.end(), 0.0);
+        std::fill(t->n.begin(), t->n.end(), 0);
+        std::fill(t->warmed.begin(), t->warmed.end(), false);
+      }
     }
   }
   return kOk;
@@ -1062,34 +1107,51 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
         return fail();
       for (const Fixup& fx : fixups)
         std::memcpy(fx.dst, fx.src, static_cast<size_t>(fx.nbytes));
+      // Per-lane ledger, counted at frame completion: bytes that
+      // actually landed (a failed/retried frame re-counts on the lane
+      // that finally carries it, which is what utilization means).
+      c.bytes.fetch_add(fr.bytes, std::memory_order_relaxed);
     }
     ++recvd;
   }
   return kOk;
 }
 
-int TcpTransport::ReadVOnRetry(Peer& p, Conn& c, const std::string& name,
-                               const ReadOp* ops, int64_t n, int target) {
+int TcpTransport::ReadVOnRetry(Peer& p, int lane0, int nlanes,
+                               const std::string& name, const ReadOp* ops,
+                               int64_t n, int target) {
   // Transport-level failures (connection reset, truncated frame, read
-  // timeout, failed dial) are transient: a reconnect-and-retry can save
-  // the op — ReadVOn resets the lane on failure and EnsureConnected
-  // redials on the next attempt, so retries are idempotent (every op
-  // rewrites its own dst span; a failed pipelined frame resets the
-  // connection so no stale response can be consumed as fresh data).
+  // timeout, failed dial) are transient: a retry can save the op —
+  // ReadVOn resets the failed lane and the retry ROTATES to the next
+  // lane of this stripe set (connected and serving a moment ago, so the
+  // retry usually rides a warm surviving stream instead of paying a
+  // redial; the closed lane redials lazily on its next use). Retries
+  // are idempotent (every op rewrites its own dst span; a failed
+  // pipelined frame resets its connection so no stale response can be
+  // consumed as fresh data), and with nlanes == 1 the rotation is the
+  // identity — the exact pre-lane behavior.
   // Classification/backoff/counter policy lives in RetryTransientLoop,
   // shared with the Store-level layer.
+  if (nlanes < 1) nlanes = 1;
+  int att = 0;
+  Conn* used = p.conns[static_cast<size_t>(lane0)].get();
   const int rc = RetryTransientLoop(
       retry_, target, &stopping_,
       static_cast<uint64_t>(target) * 0x9e3779b97f4a7c15ULL +
-          static_cast<uint64_t>(c.idx),
-      [&]() { return ReadVOn(p, c, name, ops, n); },
+          static_cast<uint64_t>(lane0),
       [&]() {
-        // The failed attempt closed the lane; this retry's
-        // EnsureConnected redials it (racy unlocked peek — a counter,
-        // not an invariant).
-        if (c.fd < 0)
+        used = p.conns[static_cast<size_t>((lane0 + att) % nlanes)].get();
+        return ReadVOn(p, *used, name, ops, n);
+      },
+      [&]() {
+        // The failed attempt closed ITS lane (ReadVOn's fail(), or a
+        // dial that never opened it); count the redial the stripe now
+        // owes (racy unlocked peek — a counter, not an invariant).
+        if (used->fd < 0)
           retry_.reconnects.fetch_add(1, std::memory_order_relaxed);
-      });
+        ++att;  // rotate: the next attempt runs on the next lane
+      },
+      retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9);
   if (rc == kErrPeerLost && DebugOn())
     std::fprintf(stderr, "[dds r%d] read to r%d exhausted retry budget "
                  "-> peer lost\n", rank_, target);
@@ -1287,6 +1349,111 @@ void TcpTransport::RoutingState(int cls, double* cma_bw, double* tcp_bw,
   *calibrated = rc.calibrated ? 1 : 0;
 }
 
+// Clean warm samples the tuner needs per level before judging it
+// (mirrors kMinRouteSamples; one sample per level is a wake-up
+// measurement, not a comparison).
+constexpr int kMinLaneSamples = 2;
+// A level must beat its predecessor's throughput by this factor to keep
+// the ramp going; below it, per-lane throughput has stopped scaling and
+// the extra streams are pure dispatch/syscall overhead.
+constexpr double kLaneGrowth = 1.15;
+
+int TcpTransport::StripeLanes(LaneTuner& t) {
+  std::lock_guard<std::mutex> lock(lane_mu_);
+  return t.parked ? t.active : t.levels[static_cast<size_t>(t.level)];
+}
+
+void TcpTransport::RecordLaneSample(LaneTuner& t, int lanes,
+                                    int64_t bytes, double secs,
+                                    bool cold) {
+  if (bytes <= 0 || secs <= 0.0) return;
+  const double bw = static_cast<double>(bytes) / secs;
+  std::lock_guard<std::mutex> lock(lane_mu_);
+  if (t.parked) return;
+  const size_t lv = static_cast<size_t>(t.level);
+  // Concurrent batches (depth>1 readahead windows) can complete after
+  // the level advanced; a sample measured at a different width says
+  // nothing about the current level.
+  if (lanes != t.levels[lv]) return;
+  // Dial-tainted windows time the handshake, not the stripe (same rule
+  // as RecordRouteSample); discard while the level is unseeded —
+  // bounded, also like the router: a peer set that redials every
+  // window (idle-closing server, sustained chaos) must not pin the
+  // ramp at level 0 forever, so after 4 discards the tainted number
+  // beats having none.
+  if (cold && t.n[lv] == 0 && t.cold_skips < 4) {
+    ++t.cold_skips;
+    return;
+  }
+  // Each level's first clean window re-warms idle lanes/pool threads;
+  // its sample is discarded so the estimate starts warm.
+  if (!t.warmed[lv]) {
+    t.warmed[lv] = true;
+    return;
+  }
+  t.bw[lv] = t.bw[lv] == 0.0 ? bw : 0.5 * t.bw[lv] + 0.5 * bw;
+  ++t.n[lv];
+  ++t.samples;
+  if (t.n[lv] < kMinLaneSamples) return;
+  const bool scaled =
+      t.level == 0 ||
+      t.bw[lv] > kLaneGrowth * t.bw[static_cast<size_t>(t.level - 1)];
+  if (scaled && lv + 1 < t.levels.size()) {
+    ++t.level;  // keep ramping: the last doubling still paid
+    return;
+  }
+  // Ramp over (growth stalled, or the pool size is fully measured):
+  // park on the best-measured level outright.
+  size_t best = 0;
+  for (size_t i = 1; i <= lv; ++i)
+    if (t.bw[i] > t.bw[best]) best = i;
+  t.parked = true;
+  t.active = t.levels[best];
+  std::fprintf(stderr,
+               "[dds r%d] %s striped reads parked at %d lane(s) "
+               "(%.2f GB/s; next level %s)\n",
+               rank_, t.name, t.active, t.bw[best] / 1e9,
+               scaled ? "unmeasured (pool cap)" : "stopped scaling");
+}
+
+void TcpTransport::LaneState(int64_t out[8]) {
+  std::lock_guard<std::mutex> lock(lane_mu_);
+  const LaneTuner& t = bulk_lanes_;
+  double best = 0.0;
+  for (double b : t.bw) best = b > best ? b : best;
+  out[0] = t.levels.empty() ? 1 : t.levels.back();  // pool size
+  out[1] = t.parked ? t.active
+                    : t.levels[static_cast<size_t>(t.level)];
+  out[2] = t.parked ? 1 : 0;
+  out[3] = t.autotune ? 1 : 0;
+  out[4] = t.samples + scatter_lanes_.samples;
+  out[5] = static_cast<int64_t>(best);
+  const LaneTuner& sc = scatter_lanes_;
+  out[6] = sc.parked ? sc.active
+                     : sc.levels[static_cast<size_t>(sc.level)];
+  out[7] = sc.parked ? 1 : 0;
+}
+
+int TcpTransport::LaneBytes(int target, int64_t* out, int cap) {
+  if (!out || cap <= 0) return 0;
+  // Same target validation as the read entry points: an out-of-range
+  // rank must error, not read as "no traffic to that peer".
+  if (target < -1 || target >= world_) return kErrInvalidArg;
+  int nlanes = 0;
+  for (const auto& p : peers_)
+    if (p) nlanes = std::max(nlanes, static_cast<int>(p->conns.size()));
+  nlanes = std::min(nlanes, cap);
+  for (int i = 0; i < nlanes; ++i) out[i] = 0;
+  for (int r = 0; r < world_; ++r) {
+    if (target >= 0 && r != target) continue;
+    const Peer& p = *peers_[r];
+    for (size_t ci = 0;
+         ci < p.conns.size() && ci < static_cast<size_t>(nlanes); ++ci)
+      out[ci] += p.conns[ci]->bytes.load(std::memory_order_relaxed);
+  }
+  return nlanes;
+}
+
 int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
                              int64_t nreqs) {
   // Same-host fast path first: whole per-peer op lists served with
@@ -1438,59 +1605,88 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     reqs = rest.data();
     nreqs = static_cast<int64_t>(rest.size());
   }
-  // Flatten peers × striped connections into one leaf-task list, then run
+  // Flatten peers × striped lanes into one leaf-task list, then run
   // the leaves on the persistent pool (one inline for guaranteed
   // progress). Flat leaves mean pool tasks never wait on nested pool
   // tasks, so the pool cannot self-deadlock.
   struct Leaf {
     Peer* p;
-    Conn* c;
+    int lane;    // pool index of this stripe's lane
+    int nlanes;  // lanes this request striped over (retry rotation set)
     int target;  // peer rank, for retry classification/diagnostics
     std::vector<ReadOp> ops;
   };
   std::vector<Leaf> leaves;
-  // A TCP bandwidth sample is only meaningful to the routing decision if
-  // it measures traffic CMA could have carried instead: at least one
-  // single bulk-sized request to a CMA-capable (same-host) peer, and NO
-  // cross-host leaves in the batch (the sample is bytes/wall-time over
-  // the whole batch — mixed batches would let DCN reads drag
-  // tcp_bulk_bw_ down and mask a genuinely faster same-host socket
-  // path, or inflate it when the DCN leaves parallelize).
+  // Pass 1 — validate and classify. Each request's byte total is
+  // computed ONCE and cached (the leaf pass below reuses it; op lists
+  // run to 16k+ entries on scatter batches). Lane-tuner class: BULK
+  // when any request's bytes reach the byte-striping threshold,
+  // otherwise SCATTER when any op count reaches the dealing threshold
+  // (judged against the POOL size — the level-1 windows that seed the
+  // tuner ramp run unstriped by definition, yet they are exactly the
+  // 1-lane baseline the higher levels are compared against). Routing
+  // hygiene rides the same pass: a TCP bandwidth sample is only
+  // meaningful to the CMA/TCP routing decision if it measures traffic
+  // CMA could have carried instead — bulk needs one bulk-sized request
+  // to a CMA-capable peer and no cross-host leaves (mixed batches
+  // would let DCN reads drag the estimate, or inflate it when they
+  // parallelize); scatter additionally needs NO bulk request (its copy
+  // time would drown the per-op signal).
+  bool lane_bulk = false, lane_scatter = false;
   bool tcp_bulk_routable = false;
-  // Same hygiene for the scatter class: a TCP scatter sample counts only
-  // when every leaf targets a CMA-capable peer AND no bulk request rides
-  // in the batch (its copy time would drown the per-op signal).
   bool tcp_scatter_routable = false;
   bool any_bulk_req = false;
   bool all_cma = true;
+  int64_t tcp_bytes = 0;
+  std::vector<int64_t> req_totals(static_cast<size_t>(nreqs), 0);
   for (int64_t ri = 0; ri < nreqs; ++ri) {
     const PeerReadV& rq = reqs[ri];
     if (rq.target < 0 || rq.target >= world_ || rq.target == rank_)
       return kErrInvalidArg;
     if (rq.n == 0) continue;
     Peer& p = *peers_[rq.target];
-    const int nconn = static_cast<int>(p.conns.size());
-
-    // Fan out across the pool when EITHER the bytes justify striping big
-    // ops OR the op count justifies spreading per-op serving cost. The
-    // second clause is the scattered-batch pattern (a DistributedSampler
-    // permutation): hundreds of small rows per peer never reach the byte
-    // threshold, yet one connection serializes them behind a single
-    // serving thread — dealing whole ops round-robin engages nconn
-    // serving threads on the target.
+    const int64_t pool = static_cast<int64_t>(p.conns.size());
     int64_t total = 0;
     for (int64_t i = 0; i < rq.n; ++i) total += rq.ops[i].nbytes;
-    {
-      std::lock_guard<std::mutex> lock(p.cma_mu);
-      const bool cma_ok = p.cma_state == 1;
-      if (total >= kBulkBytes) tcp_bulk_routable |= cma_ok;
-      else if (rq.n >= kScatterMinOps) tcp_scatter_routable |= cma_ok;
-      any_bulk_req = any_bulk_req || total >= kBulkBytes;
-      all_cma = all_cma && cma_ok;
+    req_totals[static_cast<size_t>(ri)] = total;
+    tcp_bytes += total;
+    if (pool > 1) {
+      if (total >= 2 * kStripeBytes) lane_bulk = true;
+      else if (rq.n >= 2 * pool) lane_scatter = true;
     }
+    std::lock_guard<std::mutex> lock(p.cma_mu);
+    const bool cma_ok = p.cma_state == 1;
+    if (total >= kBulkBytes) tcp_bulk_routable |= cma_ok;
+    else if (rq.n >= kScatterMinOps) tcp_scatter_routable |= cma_ok;
+    any_bulk_req = any_bulk_req || total >= kBulkBytes;
+    all_cma = all_cma && cma_ok;
+  }
+  // One lane-count decision per batch, from the matching class's
+  // tuner: the tuner's sample is bytes/wall-time over the WHOLE batch,
+  // so every request in it must have striped at the same width for the
+  // sample to mean anything.
+  LaneTuner& lane_tuner = lane_bulk ? bulk_lanes_ : scatter_lanes_;
+  const int stripe_lanes = StripeLanes(lane_tuner);
+  const bool lane_sample = lane_bulk || lane_scatter;
+
+  // Pass 2 — build the peer × lane leaves. Fan out across the lane set
+  // when EITHER the bytes justify striping big ops OR the op count
+  // justifies spreading per-op serving cost. The second clause is the
+  // scattered-batch pattern (a DistributedSampler permutation):
+  // hundreds of small rows per peer never reach the byte threshold,
+  // yet one connection serializes them behind a single serving thread
+  // — dealing whole ops round-robin engages nconn serving threads on
+  // the target.
+  for (int64_t ri = 0; ri < nreqs; ++ri) {
+    const PeerReadV& rq = reqs[ri];
+    if (rq.n == 0) continue;
+    Peer& p = *peers_[rq.target];
+    const int nconn = std::min(stripe_lanes,
+                               static_cast<int>(p.conns.size()));
+    const int64_t total = req_totals[static_cast<size_t>(ri)];
     if (nconn <= 1 ||
         (total < 2 * kStripeBytes && rq.n < 2 * nconn)) {
-      leaves.push_back(Leaf{&p, p.conns[0].get(), rq.target,
+      leaves.push_back(Leaf{&p, 0, 1, rq.target,
                             std::vector<ReadOp>(rq.ops, rq.ops + rq.n)});
       continue;
     }
@@ -1501,7 +1697,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
         DealChunks(rq.ops, rq.n, kStripeBytes, nconn);
     for (int ci = 0; ci < nconn; ++ci)
       if (!lists[ci].empty())
-        leaves.push_back(Leaf{&p, p.conns[ci].get(), rq.target,
+        leaves.push_back(Leaf{&p, ci, nconn, rq.target,
                               std::move(lists[ci])});
   }
   if (leaves.empty()) return kOk;
@@ -1510,35 +1706,49 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   const auto tcp_t0 = std::chrono::steady_clock::now();
   std::vector<int> rcs(leaves.size(), kOk);
   TaskGroup group(&pool_);
-  for (size_t li = 1; li < leaves.size(); ++li) {
-    Leaf* lf = &leaves[li];
-    int* rc = &rcs[li];
-    group.Launch([this, lf, &name, rc]() {
-      *rc = ReadVOnRetry(*lf->p, *lf->c, name, lf->ops.data(),
-                         static_cast<int64_t>(lf->ops.size()), lf->target);
-    });
+  {
+    // One enqueue pass under one pool lock: a lane-striped window fetch
+    // dispatches peers × lanes leaves at once, and per-leaf lock+notify
+    // is measurable dispatch overhead at that fan-out.
+    std::vector<std::function<void()>> tasks;
+    tasks.reserve(leaves.size() > 0 ? leaves.size() - 1 : 0);
+    for (size_t li = 1; li < leaves.size(); ++li) {
+      Leaf* lf = &leaves[li];
+      int* rc = &rcs[li];
+      tasks.emplace_back([this, lf, &name, rc]() {
+        *rc = ReadVOnRetry(*lf->p, lf->lane, lf->nlanes, name,
+                           lf->ops.data(),
+                           static_cast<int64_t>(lf->ops.size()),
+                           lf->target);
+      });
+    }
+    group.LaunchMany(std::move(tasks));
   }
-  rcs[0] = ReadVOnRetry(*leaves[0].p, *leaves[0].c, name,
-                        leaves[0].ops.data(),
+  rcs[0] = ReadVOnRetry(*leaves[0].p, leaves[0].lane, leaves[0].nlanes,
+                        name, leaves[0].ops.data(),
                         static_cast<int64_t>(leaves[0].ops.size()),
                         leaves[0].target);
   group.Wait();
   for (int rc : rcs)
     if (rc != kOk) return rc;
+  const double tcp_secs = std::chrono::duration<double>(
+      std::chrono::steady_clock::now() - tcp_t0).count();
+  const bool tcp_cold =
+      dials_.load(std::memory_order_relaxed) != dials0;
+  // Lane-tuner sample: a batch with at least one stripe/deal-eligible
+  // request, at this batch's uniform lane width, folded into ITS
+  // class's tuner. Cross-host batches count too — the tuner measures
+  // the wire path itself, not a CMA comparison.
+  if (lane_sample)
+    RecordLaneSample(lane_tuner, stripe_lanes, tcp_bytes, tcp_secs,
+                     tcp_cold);
   const bool bulk_sample = tcp_bulk_routable && all_cma;
   const bool scatter_sample =
       tcp_scatter_routable && all_cma && !any_bulk_req;
   if (bulk_sample || scatter_sample) {
-    int64_t tcp_bytes = 0;
-    for (const Leaf& lf : leaves)
-      for (const ReadOp& op : lf.ops) tcp_bytes += op.nbytes;
     RecordRouteSample(
         bulk_sample ? bulk_route_ : scatter_route_, /*via_tcp=*/true,
-        tcp_bytes,
-        std::chrono::duration<double>(std::chrono::steady_clock::now() -
-                                      tcp_t0)
-            .count(),
-        /*cold=*/dials_.load(std::memory_order_relaxed) != dials0);
+        tcp_bytes, tcp_secs, /*cold=*/tcp_cold);
   }
   return kOk;
 }

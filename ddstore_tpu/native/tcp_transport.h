@@ -137,6 +137,17 @@ class TcpTransport : public Transport {
                     int64_t* decisions, int64_t* crossovers, int* via_tcp,
                     int* calibrated);
 
+  // Lane (striped-connection) observability. LaneState fills
+  // [max_lanes, active_lanes, parked, autotune, samples,
+  //  best_bw_bytes_per_s, scatter_active_lanes, scatter_parked] —
+  // indices 1-5 describe the bulk-stripe tuner (the headline), 6-7 the
+  // scatter-class tuner. LaneBytes fills per-lane byte totals served
+  // over TCP/UDS (target >= 0: that peer's lanes; -1: summed across
+  // peers, lane-index-aligned) and returns the lane count written
+  // (bounded by `cap`).
+  void LaneState(int64_t out[8]);
+  int LaneBytes(int target, int64_t* out, int cap);
+
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
   int ReadV(int target, const std::string& name, const ReadOp* ops,
@@ -150,6 +161,13 @@ class TcpTransport : public Transport {
   // Every read leaf carries its own bounded reconnect-and-retry (see
   // ReadVOnRetry); the Store must not add a second layer on top.
   bool RetriesInternally() const override { return true; }
+  // Per-store deadline share (see Store::SetRetryDeadline): applied to
+  // every leaf's RetryTransientLoop while set.
+  void SetRetryDeadline(double seconds) override {
+    retry_deadline_ns_.store(
+        seconds > 0.0 ? static_cast<int64_t>(seconds * 1e9) : 0,
+        std::memory_order_relaxed);
+  }
   // Leaf-level retry/reconnect counters ([transient, retries, reconnects,
   // backoff_ms, giveups, fatal, last_peer] — see RetryStats).
   void RetryCounters(int64_t out[7]) const { retry_.Snapshot(out); }
@@ -162,10 +180,13 @@ class TcpTransport : public Transport {
   WorkerPool* worker_pool() override { return &pool_; }
 
  private:
-  // One TCP connection to a peer. A peer owns a small pool of these
-  // (DDSTORE_CONNS_PER_PEER, default 4): a single stream can't saturate
-  // loopback/DCN, and each connection gets its own serving thread on the
-  // target, so large reads stripe across streams and server cores.
+  // One TCP connection to a peer — a "lane". A peer owns a small pool of
+  // these (DDSTORE_TCP_LANES; legacy alias DDSTORE_CONNS_PER_PEER): a
+  // single stream can't saturate loopback/DCN, and each lane gets its
+  // own serving thread on the target, so large reads stripe across
+  // streams and server cores. How many of the pooled lanes a striped
+  // read actually engages is governed by the lane autotuner (LaneTuner
+  // below) unless DDSTORE_TCP_LANES_AUTOTUNE=0 pins it at the pool size.
   struct Conn {
     int fd = -1;
     int idx = 0;    // position in the pool; picks the NIC pairing
@@ -174,6 +195,10 @@ class TcpTransport : public Transport {
     // permanently until UpdatePeer swaps the endpoint).
     bool uds_tried = false;
     std::mutex mu;  // serializes use of this connection
+    // Response payload bytes this lane has carried (per-peer per-lane
+    // observability: lane utilization/balance is diagnosable from the
+    // BENCH json alone). Atomic: LaneBytes snapshots without taking mu.
+    std::atomic<int64_t> bytes{0};
   };
   struct Peer {
     std::vector<std::string> hosts;  // one entry per advertised NIC
@@ -200,10 +225,15 @@ class TcpTransport : public Transport {
   int ReadVOn(Peer& p, Conn& c, const std::string& name, const ReadOp* ops,
               int64_t n);
   // ReadVOn + transient classification + bounded exponential-backoff
-  // retry (reconnecting the lane as needed). Transport-level failures
-  // (reset, truncated frame, read timeout) are TRANSIENT; server-reported
-  // data errors are FATAL; an exhausted budget returns kErrPeerLost.
-  int ReadVOnRetry(Peer& p, Conn& c, const std::string& name,
+  // retry. Transport-level failures (reset, truncated frame, read
+  // timeout) are TRANSIENT; server-reported data errors are FATAL; an
+  // exhausted budget returns kErrPeerLost. Retries ROTATE across the
+  // `nlanes` lanes starting at `lane0`: a transient fault on one lane
+  // re-runs only that stripe, on the next (surviving, likely still
+  // connected) lane — the failed lane was closed by ReadVOn's fail() and
+  // redials lazily on its next use. With nlanes == 1 every attempt lands
+  // back on the same lane: the exact pre-lane retry contract.
+  int ReadVOnRetry(Peer& p, int lane0, int nlanes, const std::string& name,
                    const ReadOp* ops, int64_t n, int target);
   void AcceptLoop(int lfd, bool is_tcp);
   void HandleConnection(int fd);
@@ -304,6 +334,51 @@ class TcpTransport : public Transport {
   //                          part-lists than cores (a 1-core box pays
   //                          pure dispatch overhead for each extra part)
 
+  // Adaptive lane autotuning, in the style of the router above: more
+  // lanes only pay while the extra streams land on idle cores/serving
+  // threads — past that knee each lane just slices the same aggregate
+  // thinner and adds dispatch/syscall overhead. The tuner measures
+  // striped-read throughput at geometrically increasing lane counts
+  // (1, 2, 4, ... pool size), discarding each level's first (warm-up)
+  // window and any dial-tainted window exactly like RecordRouteSample,
+  // and PARKS on the best-measured level the first time a level fails
+  // to beat its predecessor by kLaneGrowth — per-lane throughput has
+  // stopped scaling. Parking is one-shot (an UpdatePeer recovery resets
+  // it with the route estimates: the replacement peer re-measures).
+  // One tuner PER TRAFFIC CLASS, like the router: bulk stripes are
+  // byte-bound (lanes add parallel streams/serving cores) while
+  // scatter deals whole small ops (lanes shrink every frame and
+  // multiply per-frame cost) — measured on the 2-core bench kernel the
+  // classes' optima differ by >3x, so one shared verdict would park
+  // one class on the other's width.
+  // DDSTORE_TCP_LANES_AUTOTUNE=0 pins striping at the full pool size.
+  struct LaneTuner {
+    const char* name = "bulk";  // log/observability label
+    bool autotune = true;
+    bool parked = false;
+    int active = 1;            // lanes striped reads use once parked
+    int level = 0;             // index into levels while measuring
+    std::vector<int> levels;   // 1, 2, 4, ..., max_lanes
+    std::vector<double> bw;    // per-level EWMA bytes/s
+    std::vector<int> n;        // clean warm samples folded per level
+    std::vector<bool> warmed;  // per-level warm-up window consumed
+    int cold_skips = 0;        // dial-tainted windows discarded (bounded
+    //                            like the router's: a peer that redials
+    //                            every window must not pin the ramp)
+    int64_t samples = 0;       // clean samples folded (observability)
+  };
+  std::mutex lane_mu_;
+  LaneTuner bulk_lanes_;
+  LaneTuner scatter_lanes_;
+  // Lanes the NEXT striped read of the class should engage (the parked
+  // count, or the level currently being measured).
+  int StripeLanes(LaneTuner& t);
+  // Fold one all-TCP batch's (bytes, seconds) at `lanes` into the
+  // class's tuner. `cold` marks a window that included a dial
+  // (discarded while the level is unseeded, same rule as the router).
+  void RecordLaneSample(LaneTuner& t, int lanes, int64_t bytes,
+                        double secs, bool cold);
+
   // Decide the path for one request of the class (advances the probe
   // counter).
   bool RouteViaTcp(RouteClass& rc);
@@ -325,6 +400,8 @@ class TcpTransport : public Transport {
 
   // Leaf-retry accounting (ReadVOnRetry).
   RetryStats retry_;
+  // Deadline override for leaf retries (nanos; 0 = none).
+  std::atomic<int64_t> retry_deadline_ns_{0};
 
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,

@@ -29,6 +29,25 @@ void WorkerPool::Submit(std::function<void()> fn) {
   cv_.notify_one();
 }
 
+void WorkerPool::SubmitMany(std::vector<std::function<void()>> fns) {
+  if (fns.empty()) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& fn : fns) queue_.push_back(std::move(fn));
+    // Same growth rule as Submit, applied for the whole burst under one
+    // lock: a lane-striped fan-out (peers × lanes leaves) provisions
+    // its width in one pass instead of one lock+notify round-trip per
+    // leaf.
+    int64_t avail = idle_;  // idle workers + threads spawned this burst
+    while (static_cast<int64_t>(queue_.size()) > avail &&
+           static_cast<int>(threads_.size()) < max_threads_) {
+      threads_.emplace_back([this] { WorkerLoop(); });
+      ++avail;
+    }
+  }
+  cv_.notify_all();
+}
+
 void WorkerPool::WorkerLoop() {
   std::unique_lock<std::mutex> lock(mu_);
   for (;;) {
@@ -61,6 +80,23 @@ void TaskGroup::Launch(std::function<void()> fn) {
     std::lock_guard<std::mutex> lock(st->mu);
     if (--st->pending == 0) st->cv.notify_all();
   });
+}
+
+void TaskGroup::LaunchMany(std::vector<std::function<void()>> fns) {
+  if (fns.empty()) return;
+  {
+    std::lock_guard<std::mutex> lock(state_->mu);
+    state_->pending += static_cast<int64_t>(fns.size());
+  }
+  std::vector<std::function<void()>> wrapped;
+  wrapped.reserve(fns.size());
+  for (auto& fn : fns)
+    wrapped.emplace_back([st = state_, fn = std::move(fn)]() {
+      fn();
+      std::lock_guard<std::mutex> lock(st->mu);
+      if (--st->pending == 0) st->cv.notify_all();
+    });
+  pool_->SubmitMany(std::move(wrapped));
 }
 
 void TaskGroup::Wait() {

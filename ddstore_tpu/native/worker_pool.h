@@ -40,6 +40,12 @@ class WorkerPool {
   // existing ones are busy and the cap allows.
   void Submit(std::function<void()> fn);
 
+  // Enqueue a whole burst under ONE lock acquisition + one broadcast
+  // wake, provisioning threads for the burst's width in the same pass —
+  // the lane-striped fan-out dispatches peers × lanes leaves at once,
+  // where per-leaf lock+notify is measurable overhead.
+  void SubmitMany(std::vector<std::function<void()>> fns);
+
   int max_threads() const { return max_threads_; }
 
  private:
@@ -65,6 +71,8 @@ class TaskGroup {
 
   // Submit fn to the pool as part of this group.
   void Launch(std::function<void()> fn);
+  // Submit a burst as one batch (WorkerPool::SubmitMany).
+  void LaunchMany(std::vector<std::function<void()>> fns);
   // Block until every launched task has finished.
   void Wait();
 

@@ -693,6 +693,29 @@ class DDStore:
         wires this in as ``summary()["faults"]``."""
         return self._native.fault_stats()
 
+    def set_retry_deadline(self, seconds: float) -> None:
+        """Override this store's transient-retry deadline (seconds;
+        ``<= 0`` restores ``DDSTORE_OP_DEADLINE_S``). The degraded
+        readahead path shares one deadline budget across a window
+        give-up and its per-batch refetch through this; per-store, so
+        other stores keep their full budgets."""
+        self._native.set_retry_deadline(seconds)
+
+    def lane_state(self) -> dict:
+        """Striped-lane autotuner snapshot (TCP backend): configured
+        pool size (``DDSTORE_TCP_LANES``), the lane count striped reads
+        currently engage, whether the tuner parked, and the best
+        measured stripe bandwidth. ``{}`` for the local backend."""
+        return self._native.lane_state()
+
+    def lane_bytes(self, target: int = -1) -> list:
+        """Per-lane response bytes over the wire path since store
+        creation (``target >= 0`` for one peer, ``-1`` summed across
+        peers). Monotone — ``DeviceLoader.metrics`` diffs this per epoch
+        into ``summary()["bytes_moved"]``'s lane view. ``[]`` for the
+        local backend."""
+        return self._native.lane_bytes(target)
+
     @property
     def rank(self) -> int:
         return self.group.rank

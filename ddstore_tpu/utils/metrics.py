@@ -155,6 +155,13 @@ class PipelineMetrics:
         # loader's worker pool records from several threads.
         self._bytes_mu = threading.Lock()
         self._bytes: Dict[str, int] = {k: 0 for k in self.BYTE_KEYS}
+        # Per-lane byte ledger (multi-lane TCP transport): a cumulative
+        # per-lane-bytes source (DDStore.lane_bytes) snapshotted at
+        # epoch boundaries; bytes_moved() reports the per-epoch delta
+        # plus the derived lane utilization.
+        self._lane_source: Optional[Callable[[], List[int]]] = None
+        self._lane_begin: Optional[List[int]] = None
+        self._lane_end: Optional[List[int]] = None
         self._ra_mu = threading.Lock()
         self._ra: Dict[str, int] = {k: 0 for k in self.WINDOW_KEYS}
         self._ra_windows = 0
@@ -234,6 +241,28 @@ class PipelineMetrics:
             out.update(self._fault_events)
         return out
 
+    def set_lane_source(self,
+                        source: Optional[Callable[[], List[int]]]) -> None:
+        """Attach a zero-arg callable returning cumulative per-lane byte
+        totals (``DDStore.lane_bytes``). Snapshotted at epoch
+        boundaries; ``bytes_moved()`` then carries ``lane_bytes`` (the
+        per-epoch per-lane deltas), ``tcp_lanes_used`` and
+        ``lane_utilization`` (delta evenness across the lanes that
+        moved bytes: 1.0 = perfectly balanced stripes)."""
+        self._lane_source = source
+
+    def _snap_lanes(self) -> Optional[List[int]]:
+        if self._lane_source is None:
+            return None
+        try:
+            snap = [int(v) for v in self._lane_source()]
+        except Exception:
+            return None
+        # A backend without lanes (the local transport) reports an
+        # empty list: treat it as "no source" so its epoch records
+        # don't grow dead lane keys.
+        return snap or None
+
     def add_bytes(self, **counters: int) -> None:
         """Fold one fetch's bytes-moved ledger into the epoch totals
         (``bytes_local_get`` / ``bytes_over_ici`` / ``bytes_over_dcn``
@@ -245,9 +274,31 @@ class PipelineMetrics:
                                    f"expected one of {self.BYTE_KEYS}")
                 self._bytes[k] += int(v)
 
-    def bytes_moved(self) -> Dict[str, int]:
+    def bytes_moved(self) -> Dict:
         with self._bytes_mu:
-            return dict(self._bytes)
+            out: Dict = dict(self._bytes)
+        if self._lane_begin is not None:
+            # Frozen at epoch_end like the plan/fault snapshots (the
+            # next epoch's readahead issuer starts prefetching before
+            # the caller reads the summary — a live snapshot would leak
+            # its bytes into this epoch's delta); live only mid-epoch.
+            end = self._lane_end if self._lane_end is not None \
+                else self._snap_lanes()
+            if end is not None:
+                begin = self._lane_begin
+                delta = [max(0, e - (begin[i] if i < len(begin) else 0))
+                         for i, e in enumerate(end)]
+                used = sum(1 for d in delta if d > 0)
+                peak = max(delta, default=0)
+                out["lane_bytes"] = delta
+                out["tcp_lanes_used"] = used
+                # Evenness across the lanes that actually carried bytes:
+                # balanced round-robin stripes read ~1.0; a batch that
+                # fit one lane reads 1.0 with tcp_lanes_used == 1.
+                out["lane_utilization"] = round(
+                    sum(delta) / (used * peak), 4) if used and peak \
+                    else 0.0
+        return out
 
     def add_window(self, *, wait_s: float, idle_s: float,
                    fetch_s: float = 0.0, **counters: int) -> None:
@@ -315,6 +366,8 @@ class PipelineMetrics:
         self._plan_end = None
         self._fault_begin = self._snap_faults()
         self._fault_end = None
+        self._lane_begin = self._snap_lanes()
+        self._lane_end = None
         with self._bytes_mu:
             self._bytes = {k: 0 for k in self.BYTE_KEYS}
         with self._ra_mu:
@@ -331,6 +384,7 @@ class PipelineMetrics:
         self._t_end = time.perf_counter()
         self._plan_end = self._snap_plan()
         self._fault_end = self._snap_faults()
+        self._lane_end = self._snap_lanes()
 
     @property
     def total_s(self) -> float:
@@ -361,7 +415,8 @@ class PipelineMetrics:
             if end is not None:
                 out["scatter_plan"] = plan_stats_delta(self._plan_begin, end)
         moved = self.bytes_moved()
-        if any(moved.values()):
+        if any(moved.get(k, 0) for k in self.BYTE_KEYS) \
+                or moved.get("tcp_lanes_used", 0):
             out["bytes_moved"] = moved
         if self._ra_windows:
             out["readahead"] = self.readahead_summary()

@@ -446,6 +446,99 @@ def test_soak_chaos_mode():
     assert m["fault_giveups"] == 0, m
 
 
+def test_retry_deadline_override_bounds_giveup(monkeypatch):
+    """Satellite (ISSUE 5): set_retry_deadline bounds THIS store's
+    transient-retry give-up, overriding a much larger env deadline —
+    the timed half of the shared-budget contract, with a 10x margin so
+    backoff-tail jitter and CPU noise cannot flake it."""
+    monkeypatch.setenv("DDSTORE_CMA", "0")
+    monkeypatch.setenv("DDSTORE_RETRY_MAX", "1000")
+    monkeypatch.setenv("DDSTORE_RETRY_BASE_MS", "20")
+    monkeypatch.setenv("DDSTORE_OP_DEADLINE_S", "30")  # env: huge
+
+    import time as _time
+
+    def body(s):
+        # Every serve by rank 1 resets: permanently dead from the
+        # reader's point of view, but the process stays up so dials are
+        # instant (the timing measures the retry budget, not connect
+        # timeouts).
+        fault_configure("reset:1.0", seed=9, ranks=[1])
+        s.set_retry_deadline(0.3)
+        t0 = _time.monotonic()
+        err = None
+        try:
+            s.get_batch("v", np.arange(64, 80))
+        except DDStoreError as e:
+            err = e
+        elapsed = _time.monotonic() - t0
+        s.set_retry_deadline(0.0)
+        fault_configure("", 0)
+        return err, elapsed
+
+    err, elapsed = _run_pair(body, backend="tcp", rows=64,
+                             monkeypatch=monkeypatch)
+    assert err is not None and err.code == ERR_PEER_LOST, err
+    # Without the override the giveup would burn toward the 30s env
+    # deadline (RETRY_MAX never binds at 1000); with it, 0.3s budget +
+    # one backoff tail. 3s = 10x the override, 1/10th the env deadline.
+    assert elapsed <= 3.0, \
+        f"give-up took {elapsed:.2f}s: set_retry_deadline not applied"
+
+
+def test_dead_owner_refetch_shares_window_deadline(monkeypatch):
+    """Satellite (ISSUE 5): a permanently dead owner inside the
+    readahead path surfaces kErrPeerLost within ~1x OP_DEADLINE, not
+    ~2x — the per-batch refetch runs on whatever budget the window's
+    own give-up left over, instead of a fresh full deadline per refetch
+    chunk (the PR 4 worst case). Asserted on the MECHANISM (the engine
+    hands the refetch the reduced remainder and clears it after), which
+    is deterministic; the wall-clock bound itself is covered with a
+    wide margin by test_retry_deadline_override_bounds_giveup."""
+    from ddstore_tpu.data.readahead import EpochReadahead
+
+    deadline = 2.0
+    monkeypatch.setenv("DDSTORE_CMA", "0")
+    monkeypatch.setenv("DDSTORE_RETRY_MAX", "1000")  # deadline governs
+    monkeypatch.setenv("DDSTORE_RETRY_BASE_MS", "20")
+    monkeypatch.setenv("DDSTORE_OP_DEADLINE_S", str(deadline))
+
+    def body(s):
+        calls = []
+
+        class Spy:
+            def __getattr__(self, k):
+                return getattr(s, k)
+
+            def set_retry_deadline(self, seconds):
+                calls.append(float(seconds))
+                s.set_retry_deadline(seconds)
+
+        fault_configure("reset:1.0", seed=9, ranks=[1])
+        batches = [np.arange(64, 96), np.arange(96, 128)]
+        err = None
+        try:
+            with EpochReadahead(Spy(), "v", iter(batches),
+                                window_batches=2, depth=1) as ra:
+                ra.get_batch(0)
+        except DDStoreError as e:
+            err = e
+        fault_configure("", 0)
+        assert s.async_pending() == 0
+        return err, calls
+
+    err, calls = _run_pair(body, backend="tcp", rows=64,
+                           monkeypatch=monkeypatch)
+    assert err is not None and err.code == ERR_PEER_LOST, err
+    # The engine set the refetch budget exactly once, to the window's
+    # REMAINDER — here exactly the floor min(2, 0.25*deadline): the
+    # deadline-governed give-up consumed the whole window budget — and
+    # never a fresh full deadline; cleared on the error path.
+    assert len(calls) == 2, calls
+    assert calls[0] == min(2.0, 0.25 * deadline), calls
+    assert calls[1] == 0.0, calls
+
+
 def test_async_error_path_releases_ticket():
     """Satellite (error-path audit): a failed async batched read frees
     its scratch and releases its ticket — async_pending()==0 afterwards

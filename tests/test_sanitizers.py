@@ -130,6 +130,58 @@ ts = [threading.Thread(target=err_worker, args=(r, errs))
 [t.start() for t in ts]
 [t.join() for t in ts]
 assert not errs, errs
+
+# Lane paths under the sanitizer (ISSUE 5 satellite): pinned 4-lane
+# striping on the wire path, injected resets mid-stripe (the failed
+# stripe retries on a surviving lane), per-lane counters read
+# concurrently, and a striped async read failing its whole budget —
+# every stripe's scratch/ticket must be released (async_pending()==0).
+os.environ["DDSTORE_TCP_LANES"] = "4"
+os.environ["DDSTORE_TCP_LANES_AUTOTUNE"] = "0"
+os.environ["DDSTORE_CMA"] = "0"
+os.environ["DDSTORE_RETRY_BASE_MS"] = "1"
+LANENAME = uuid.uuid4().hex
+LROWS, LROW = 16, 1 << 17  # 1 MiB rows -> striped reads
+
+def lane_worker(rank, errs):
+    try:
+        group = ThreadGroup(LANENAME, rank, 2)
+        with DDStore(group, backend="tcp") as s:
+            s.add("v", np.full((LROWS, LROW), rank + 1, np.float64))
+            s.barrier()
+            if rank == 0:
+                clean = s.get("v", LROWS, 8).copy()
+                fault_configure("reset:0.2", seed=11, ranks=[1])
+                for _ in range(3):
+                    got = s.get("v", LROWS, 8)
+                    assert (got == clean).all()
+                    s.lane_bytes()   # concurrent counter reads
+                    s.lane_state()
+                fault_configure("", 0)
+                # whole-budget failure across stripes: every lane's
+                # ticket/scratch released on the error path
+                os.environ["DDSTORE_RETRY_MAX"] = "0"
+                fault_configure("reset:1.0", seed=12, ranks=[1])
+                h = s.get_batch_async("v", np.arange(LROWS, LROWS + 8))
+                try:
+                    h.wait()
+                    errs.append((rank, "striped async survived 100% resets"))
+                except DDStoreError:
+                    pass
+                finally:
+                    fault_configure("", 0)
+                    os.environ["DDSTORE_RETRY_MAX"] = "8"
+                assert s.async_pending() == 0, s.async_pending()
+            s.barrier()
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=lane_worker, args=(r, errs))
+      for r in range(2)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
 print("stress ok")
 """
 
