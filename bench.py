@@ -1067,6 +1067,207 @@ def lanes_bench(world=4, num=16384, dim=256, batch=256, nlanes=4):
     return out
 
 
+def sched_bench(world=4, num=16384, dim=256, batch=256):
+    """Cost-model scheduler A/B (ISSUE 6 acceptance): the SAME 4-owner
+    ThreadGroup TCP workload twice — ``DDSTORE_SCHED=0`` (the three
+    independent tuners, exact PR 1-5 behavior) vs ``DDSTORE_SCHED=1``
+    (a joint route x lanes x depth x width plan applied after a warm
+    calibration epoch seeds the shared measurement substrate) — with
+    byte-identical equivalence asserted against a locally reconstructed
+    oracle BEFORE any timing, on both the scatter per-batch path and
+    the readahead window fetch leg. Each config gets its own store
+    generation (the env gate must be read before any transport
+    constructs). Acceptance ``sched_ok`` = the joint plan actually
+    ENGAGED (>= 1 knob applied) + byte identity + (delivered >= 1.0x
+    the independent-tuners baseline OR the documented no-core-headroom
+    regime: on a box whose 1-lane fan-out already oversubscribes the
+    cores, the correct joint plan IS the baseline's knob settings, so
+    parity is the win and the regime is exported with the record)."""
+    import threading
+    import uuid
+
+    import numpy as np
+
+    env = {"DDSTORE_POOL_THREADS": "16"}
+    backup = {k: os.environ.get(k) for k in
+              list(env) + ["DDSTORE_SCHED"]}
+    os.environ.update(env)
+    out = {}
+
+    def run_config(sched_on, res):
+        from ddstore_tpu import DDStore, ThreadGroup
+        from ddstore_tpu.data.readahead import EpochReadahead
+        from ddstore_tpu.sched import Scheduler
+        from ddstore_tpu.utils.metrics import PipelineMetrics
+
+        os.environ["DDSTORE_SCHED"] = "1" if sched_on else "0"
+        name = uuid.uuid4().hex
+        errors = []
+
+        def _shard(r):
+            # Per-rank seed (lanes-bench discipline): identical shards
+            # would let a wrong-peer read return "correct" bytes.
+            return np.random.default_rng(23 + r).standard_normal(
+                (num, dim)).astype(np.float32)
+
+        def run_rank(rank):
+            g = ThreadGroup(name, rank, world)
+            with DDStore(g, backend="tcp") as s:
+                s.add("bench", _shard(rank))
+                s.barrier()
+                if rank == 0:
+                    sch = Scheduler(s, nvars=1, requested_depth=2)
+                    metrics = PipelineMetrics()
+                    metrics.set_sched_source(sch.snapshot)
+                    total = world * num
+                    perm = np.random.default_rng(31).permutation(total)
+                    batches = [perm[i * batch:(i + 1) * batch]
+                               for i in range(total // batch)]
+
+                    # Equivalence BEFORE timing, duplicates included.
+                    oracle = np.concatenate(
+                        [_shard(r) for r in range(world)])
+                    eq = [np.concatenate([batches[0][:8],
+                                          batches[0][:8]]),
+                          batches[1]]
+                    with EpochReadahead(s, "bench", iter(eq),
+                                        window_batches=2, depth=2,
+                                        sched=sch) as ra:
+                        for i, b in enumerate(eq):
+                            np.testing.assert_array_equal(
+                                ra.get_batch(i, idx=b), oracle[b])
+                            np.testing.assert_array_equal(
+                                s.get_batch("bench", b), oracle[b])
+                    del oracle
+                    assert s.async_pending() == 0
+
+                    dst = np.empty((batch, dim), np.float32)
+                    nbytes = total * dim * 4
+
+                    def run_scatter():
+                        for b in batches:
+                            s.get_batch("bench", b, out=dst)
+
+                    ring_holder = {}
+
+                    def run_windowed():
+                        depth = sch.planned_depth(2)
+                        ra = EpochReadahead(
+                            s, "bench", iter(batches),
+                            window_batches=len(batches) // 2,
+                            depth=depth, metrics=metrics,
+                            ring=ring_holder.get("r"), sched=sch)
+                        for i in range(len(batches)):
+                            ra.get_batch(i)
+                        ra.close()
+                        ring_holder["r"] = ra.ring
+
+                    # Warm calibration epoch: seeds the router/lane
+                    # cells and the host-side window cells the plan is
+                    # computed from (the independent tuners use the
+                    # same windows to calibrate — symmetric A/B).
+                    run_scatter()
+                    run_windowed()
+                    # The epoch-boundary replan: with DDSTORE_SCHED=1
+                    # this applies the joint plan through the native
+                    # pins; with =0 it is a no-op (tuners keep the
+                    # knobs).
+                    sch.on_epoch()
+
+                    res["scatter_gbps"] = _best_bw(run_scatter, nbytes)
+                    metrics.epoch_start()
+                    _best_bw(run_windowed, nbytes)
+                    ra_sum = metrics.readahead_summary()
+                    res["window_fetch_gbps"] = \
+                        ra_sum.get("window_fetch_gbps_best", 0.0)
+                    res["sched"] = sch.snapshot()
+                    res["lane_state"] = s.lane_state()
+                    res["async_width"] = s.async_width
+                    assert s.async_pending() == 0
+                s.barrier()
+
+        def body(rank):
+            try:
+                run_rank(rank)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(200)
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("sched_bench rank thread hung past its "
+                               "200 s join")
+
+    try:
+        base, joint = {}, {}
+        run_config(False, base)
+        run_config(True, joint)
+        js = joint.get("sched", {})
+        plan = js.get("plan", {})
+        ncores = os.cpu_count() or 1
+        headroom = not js.get("no_core_headroom", ncores < 2 * (world - 1)
+                              + 2)
+        r_window = joint["window_fetch_gbps"] / base["window_fetch_gbps"] \
+            if base.get("window_fetch_gbps") else 0.0
+        r_scatter = joint["scatter_gbps"] / base["scatter_gbps"] \
+            if base.get("scatter_gbps") else 0.0
+        out.update({
+            "sched_window_fetch_gbps_base": round(
+                base.get("window_fetch_gbps", 0), 3),
+            "sched_window_fetch_gbps_joint": round(
+                joint.get("window_fetch_gbps", 0), 3),
+            "sched_scatter_gbps_base": round(
+                base.get("scatter_gbps", 0), 3),
+            "sched_scatter_gbps_joint": round(
+                joint.get("scatter_gbps", 0), 3),
+            "sched_vs_base_window": round(r_window, 3),
+            "sched_vs_base_scatter": round(r_scatter, 3),
+            "sched_engaged": bool(js.get("engaged", False)),
+            "sched_replans": js.get("replans", 0),
+            "sched_plan_route": plan.get("route", {}),
+            "sched_plan_lanes": plan.get("lanes", {}),
+            "sched_plan_depth": plan.get("depth"),
+            "sched_plan_width": plan.get("width"),
+            "sched_predicted_gbps": js.get("predicted_gbps", {}),
+            "sched_measured_window_gbps": js.get(
+                "measured_window_gbps", 0.0),
+            "sched_pins": {k: str(v) for k, v in
+                           js.get("pins", {}).items()},
+            "sched_async_width_joint": joint.get("async_width", 0),
+            "sched_baseline_enabled": bool(
+                base.get("sched", {}).get("enabled", True)),
+            "sched_host_cores": ncores,
+            "sched_core_headroom": bool(headroom),
+            # Acceptance (recorded, not raised — equivalence was
+            # asserted inside each config; a noisy window degrades a
+            # boolean): the joint plan engaged, bytes are identical,
+            # and delivered throughput holds the independent-tuners
+            # baseline — or the box has no core headroom, in which
+            # case knob parity IS the correct joint plan and both raw
+            # numbers are in this record (PERF_NOTES Round 10 has the
+            # regime).
+            "sched_ok": bool(
+                js.get("engaged", False)
+                and not base.get("sched", {}).get("engaged", False)
+                and base.get("window_fetch_gbps", 0) > 0
+                and ((r_window >= 1.0 and r_scatter >= 1.0)
+                     or not headroom)),
+        })
+    finally:
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Device benchmarks (LM + VAE).
 # ---------------------------------------------------------------------------
@@ -1895,6 +2096,26 @@ def _phase_lanes():
     return o
 
 
+def _phase_sched():
+    o = sched_bench()
+    plan = (f"route={o.get('sched_plan_route', {})}, "
+            f"lanes={o.get('sched_plan_lanes', {})}, "
+            f"depth={o.get('sched_plan_depth')}, "
+            f"width={o.get('sched_plan_width')}")
+    print(f"# sched A/B (independent tuners vs joint plan): window "
+          f"fetch {o.get('sched_window_fetch_gbps_base', 0):.2f} -> "
+          f"{o.get('sched_window_fetch_gbps_joint', 0):.2f} GB/s "
+          f"({o.get('sched_vs_base_window', 0):.2f}x), scatter "
+          f"{o.get('sched_scatter_gbps_base', 0):.2f} -> "
+          f"{o.get('sched_scatter_gbps_joint', 0):.2f} GB/s "
+          f"({o.get('sched_vs_base_scatter', 0):.2f}x); plan {plan}, "
+          f"{o.get('sched_replans', 0)} replans"
+          f"{'' if o.get('sched_core_headroom') else ' [no core headroom]'}"
+          f" -> {'OK' if o.get('sched_ok') else 'NOT OK'}",
+          file=sys.stderr)
+    return o
+
+
 def _phase_chaos():
     o = chaos_bench()
     print(f"# chaos: {o.get('chaos_injected', 0)} faults injected -> "
@@ -1949,6 +2170,7 @@ def _phase_devicefetch():
 # cannot eat a device phase's budget.
 _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("readahead", _phase_readahead), ("lanes", _phase_lanes),
+           ("sched", _phase_sched),
            ("vae", _phase_vae), ("gnn", _phase_gnn),
            ("devicefetch", _phase_devicefetch),
            ("numerics", _phase_numerics), ("lm", _phase_lm),
@@ -2044,6 +2266,10 @@ def main():
     # pattern) keeps a slow run from eating a device phase's budget.
     lanes_timeout = float(os.environ.get(
         "DDSTORE_LANES_PHASE_TIMEOUT_S", 420))
+    # The sched A/B runs two full store lifetimes (tuners-only vs joint
+    # plan) over the wire path; same own-cap pattern.
+    sched_timeout = float(os.environ.get(
+        "DDSTORE_SCHED_PHASE_TIMEOUT_S", 420))
     # Whole-run budget: with a wedged accelerator EVERY device phase
     # hangs to its full per-phase timeout, and 6 x 1200s of silence
     # would outlive the caller's own patience with zero output. The
@@ -2067,7 +2293,7 @@ def main():
     # exempt).
     device_phases = {n for n, _ in _PHASES
                      if n not in ("local", "tcp", "readahead", "lanes",
-                                  "chaos", "soak")}
+                                  "sched", "chaos", "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -2173,7 +2399,8 @@ def main():
             phase_timeout = {"soak": soak_timeout,
                              "ppsched": ppsched_timeout,
                              "chaos": chaos_timeout,
-                             "lanes": lanes_timeout}.get(name, timeout)
+                             "lanes": lanes_timeout,
+                             "sched": sched_timeout}.get(name, timeout)
             try:
                 out, _ = proc.communicate(timeout=min(phase_timeout, left))
             except subprocess.TimeoutExpired:

@@ -115,6 +115,20 @@ def _load() -> ctypes.CDLL:
     lib.dds_set_retry_deadline.restype = ctypes.c_int
     lib.dds_set_retry_deadline.argtypes = [ctypes.c_void_p,
                                            ctypes.c_double]
+    lib.dds_sched_cells.restype = ctypes.c_int
+    lib.dds_sched_cells.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_double),
+                                    ctypes.c_int]
+    lib.dds_sched_pin_route.restype = ctypes.c_int
+    lib.dds_sched_pin_route.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int]
+    lib.dds_sched_pin_lanes.restype = ctypes.c_int
+    lib.dds_sched_pin_lanes.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int]
+    lib.dds_set_async_width.restype = ctypes.c_int
+    lib.dds_set_async_width.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dds_async_width.restype = ctypes.c_int
+    lib.dds_async_width.argtypes = [ctypes.c_void_p]
     lib.dds_fault_configure.restype = ctypes.c_int
     lib.dds_fault_configure.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                         ctypes.c_char_p]
@@ -196,6 +210,14 @@ DEFAULT_OP_DEADLINE_S = 300.0
 LANE_STATE_KEYS = ("max_lanes", "active_lanes", "parked", "autotune",
                    "samples", "best_bw_bytes_per_s",
                    "scatter_active_lanes", "scatter_parked")
+
+
+#: column names of one :meth:`NativeStore.sched_cells` row, in native
+#: layout order (keep in sync with TcpTransport::SchedCells). ``source``
+#: 0 = CMA/TCP router cell, 1 = lane-tuner level cell; ``cls`` 0 = bulk,
+#: 1 = scatter; ``knob`` is the route (0 = cma, 1 = tcp) or the lane
+#: count the cell measures.
+SCHED_CELL_COLS = ("source", "cls", "knob", "ewma_bps", "n")
 
 
 #: dict keys of :meth:`NativeStore.fault_stats`, in native layout order.
@@ -331,6 +353,51 @@ class NativeStore:
         if n < 0:
             return []
         return list(arr)[:n]
+
+    def sched_cells(self) -> list:
+        """Warm-window substrate snapshot for the cost-model scheduler:
+        a list of dicts keyed by :data:`SCHED_CELL_COLS` — every
+        router/lane-tuner measurement cell's EWMA bytes/s and clean
+        sample count. ``[]`` for non-TCP backends (nothing to plan
+        against; the planner then leaves the transport knobs alone)."""
+        cap = 64
+        arr = (ctypes.c_double * (cap * 5))()
+        n = self._lib.dds_sched_cells(self._h, arr, cap)
+        if n < 0:
+            return []
+        return [dict(zip(SCHED_CELL_COLS, arr[i * 5:(i + 1) * 5]))
+                for i in range(n)]
+
+    def sched_pin_route(self, cls: int, mode: int) -> None:
+        """Planner route pin for traffic class ``cls`` (0 = bulk, 1 =
+        scatter): ``mode`` 0 = CMA, 1 = TCP, -1 = release to the
+        adaptive router. Ranks below the user env pins
+        (``DDSTORE_CMA_BULK``/``SCATTER``); released by a peer update."""
+        _check(self._lib.dds_sched_pin_route(self._h, int(cls), int(mode)),
+               f"sched_pin_route({cls}, {mode})")
+
+    def sched_pin_lanes(self, cls: int, lanes: int) -> None:
+        """Planner lane-width pin for traffic class ``cls``: ``lanes``
+        >= 1 pins the stripe width (clamped to the pool size), -1
+        releases to the lane autotuner."""
+        _check(self._lib.dds_sched_pin_lanes(self._h, int(cls),
+                                             int(lanes)),
+               f"sched_pin_lanes({cls}, {lanes})")
+
+    def set_async_width(self, n: int) -> None:
+        """Async admission width (concurrently RUNNING async batched
+        reads): ``n`` >= 1 overrides, <= 0 restores the
+        ``DDSTORE_ASYNC_THREADS`` / core-ladder default. Excess issues
+        queue and start as running reads complete — the ticket contract
+        is unchanged."""
+        _check(self._lib.dds_set_async_width(self._h, int(n)),
+               f"set_async_width({n})")
+
+    @property
+    def async_width(self) -> int:
+        """The admission width currently in force (override, env, or
+        the 4/2/1 core-ladder default)."""
+        return int(self._lib.dds_async_width(self._h))
 
     @property
     def barrier_seq(self) -> int:

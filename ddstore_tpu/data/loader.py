@@ -164,6 +164,27 @@ class DeviceLoader:
             # striped reads actually spread across the lane pool is
             # diagnosable from the epoch record alone.
             self.metrics.set_lane_source(store.lane_bytes)
+        # Cost-model scheduler (ddstore_tpu.sched): plans route x lanes
+        # x readahead depth x async width jointly from the shared
+        # measurement substrate, replacing the knobs' independent
+        # tuners whenever it has confident samples. Created even when
+        # DDSTORE_SCHED=0 (disabled it never pins anything) so
+        # summary()["sched"] always states the enablement — that is the
+        # fact the sched bench A/B reads. User env pins freeze their
+        # knobs; the planner plans the rest.
+        self.sched = None
+        if store is not None and hasattr(store, "sched_cells"):
+            from ..sched.planner import Scheduler
+
+            nvars = 1 + (1 if getattr(dataset, "label_var", None)
+                         else 0)
+            # requested_depth 0 = this loader runs no readahead: the
+            # scheduler then plans route/lanes only and leaves the
+            # depth/width knobs (and the store's other async users)
+            # alone.
+            self.sched = Scheduler(store, nvars=nvars,
+                                   requested_depth=int(readahead_windows))
+            self.metrics.set_sched_source(self.sched.snapshot)
         if mesh is not None and jax is None:  # pragma: no cover
             raise RuntimeError("jax unavailable but mesh given")
         # `spec` overrides the default leading-dim-over-`axis` layout, e.g.
@@ -351,6 +372,10 @@ class DeviceLoader:
             self._ra_degraded.set()
             self.readahead_fallback_reason = f"degraded mid-epoch: {e}"
             self.metrics.add_fault_event(readahead_degraded=1)
+        if self.sched is not None:
+            # Ladder engagement is a regime change: replan (outside the
+            # latch lock — the replan takes the scheduler's own lock).
+            self.sched.on_degradation("readahead")
 
     def _fetch(self, idx: np.ndarray, seq: int = 0, ra=None):
         if ra is not None and self._ra_degraded.is_set():
@@ -370,11 +395,15 @@ class DeviceLoader:
                 # below. Permanent owner death is fatal — surface it
                 # (it names the dead owner; elastic.recover is next).
                 if e.code == ERR_PEER_LOST:
+                    if self.sched is not None:
+                        self.sched.on_degradation("peer_lost")
                     raise
                 if self.collective_fallback_reason is None:
                     self.collective_fallback_reason = \
                         f"degraded mid-epoch: {e}"
                 self.metrics.add_fault_event(collective_batch_fallbacks=1)
+                if self.sched is not None:
+                    self.sched.on_degradation("collective")
                 if ra is not None:
                     # The engine raised before any window delivery for
                     # this seq (batch_rows fails before marking
@@ -397,6 +426,8 @@ class DeviceLoader:
                     # the rest of the epoch runs per-batch. Fatal codes
                     # surface.
                     if e.code == ERR_PEER_LOST:
+                        if self.sched is not None:
+                            self.sched.on_degradation("peer_lost")
                         raise
                     self._degrade_readahead(e)
             if batch is None:
@@ -430,13 +461,21 @@ class DeviceLoader:
         # two overlapping iterators of one loader must never share
         # staging buffers — the second allocates its own.
         ring, self._ra_ring = self._ra_ring, None
+        # The DEPTH knob is the scheduler's: the user's readahead_windows
+        # is the requested ceiling (and the ring budget); the planner
+        # may run shallower when the core budget says deeper windows
+        # cannot fetch concurrently anyway. DDSTORE_READAHEAD_DEPTH
+        # pins it.
+        depth = self.readahead_windows
+        if self.sched is not None:
+            depth = self.sched.planned_depth(self.readahead_windows)
         return EpochReadahead(
             self.dataset.store, self.dataset.data_var,
             self._index_batches(),
             label_var=getattr(self.dataset, "label_var", None),
             window_batches=self.readahead_window_batches,
-            depth=self.readahead_windows, metrics=self.metrics,
-            ring=ring)
+            depth=depth, metrics=self.metrics,
+            ring=ring, sched=self.sched)
 
     def __iter__(self):
         # Ordered worker pool: index batches are submitted in order and
@@ -447,6 +486,12 @@ class DeviceLoader:
         # subsequent store teardown can't race either.
         self.metrics.epoch_start()
         self._ra_degraded.clear()  # fresh epoch, fresh engine, fresh chance
+        if self.sched is not None:
+            # Epoch-boundary replan BEFORE the engine is built: the
+            # planned depth/width govern this epoch's ring and
+            # admission, and the route/lane pins land before the first
+            # fetch.
+            self.sched.on_epoch()
         ex = ThreadPoolExecutor(max_workers=self.workers,
                                 thread_name_prefix="ddstore-loader")
         futs = deque()

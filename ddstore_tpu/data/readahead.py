@@ -195,7 +195,8 @@ class EpochReadahead:
                  label_var: Optional[str] = None, window_batches: int = 8,
                  depth: int = 2, metrics=None,
                  max_window_rows: Optional[int] = None,
-                 ring: Optional[Dict[str, List[np.ndarray]]] = None):
+                 ring: Optional[Dict[str, List[np.ndarray]]] = None,
+                 sched=None):
         if window_batches <= 0:
             raise ValueError("window_batches must be positive")
         if depth <= 0:
@@ -204,6 +205,13 @@ class EpochReadahead:
         self.window_batches = int(window_batches)
         self.depth = int(depth)
         self.metrics = metrics
+        # Cost-model scheduler (sched/planner.Scheduler): each window's
+        # fetch leg feeds its host-side measurement substrate. The
+        # epoch's first window is marked `cold` — it pays ring
+        # first-touch and lane dials, the host-side analogue of the
+        # native tuners' dial-tainted windows.
+        self.sched = sched
+        self._windows_fed = 0
         self._batch_iter: Iterator = iter(batches)
         self._vars = [data_var] + ([label_var] if label_var else [])
         self._ragged = {v: store.is_ragged(v) for v in self._vars}
@@ -543,6 +551,12 @@ class EpochReadahead:
 
     def _account(self, win: _Window, stall_s: float, idle_s: float,
                  fetch_s: float) -> None:
+        if self.sched is not None and fetch_s > 0.0:
+            wbytes = sum(int(win.plan.rows.size) * rb
+                         for rb in self._row_bytes.values())
+            self.sched.observe_window(wbytes, fetch_s,
+                                      cold=self._windows_fed == 0)
+            self._windows_fed += 1
         m = self.metrics
         if m is None or not hasattr(m, "add_window"):
             return

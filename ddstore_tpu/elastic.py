@@ -232,7 +232,11 @@ def recover(store: DDStore, root: str,
         # sockets must close and CMA must re-probe the new pid.
         if r != store.rank and (r in joiners
                                 or ep != store._endpoints[r]):
-            store._native.update_peer(r, ep[0], ep[1])
+            # Through the DDStore wrapper, not the native handle: the
+            # cost-model scheduler's peer listeners must see the
+            # topology change (the native tuners and planner pins reset
+            # on the swap; the plan must be rebuilt).
+            store.update_peer(r, ep[0], ep[1])
     store._endpoints = [tuple(e) for e in endpoints]
     store.group = group
     store._generation = gen

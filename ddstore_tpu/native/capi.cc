@@ -100,6 +100,48 @@ int dds_lane_bytes(dds_handle* h, int target, int64_t* out, int cap) {
   return h->tcp->LaneBytes(target, out, cap);
 }
 
+// Warm-window substrate snapshot for the cost-model scheduler: writes
+// up to `cap` rows of 5 doubles [source (0=route, 1=lanes), cls
+// (0=bulk, 1=scatter), knob (route: 0=cma/1=tcp; lanes: lane count),
+// ewma_bytes_per_s, clean_samples] and returns the row count (keep in
+// sync with binding.py SCHED_CELL_COLS). 0 rows for non-TCP backends
+// (they have no router/lane tuners to snapshot).
+int dds_sched_cells(dds_handle* h, double* out, int cap) {
+  if (!h || !out || cap < 0) return dds::kErrInvalidArg;
+  if (!h->tcp) return 0;
+  return h->tcp->SchedCells(out, cap);
+}
+
+// Planner route pin for one traffic class (0 = bulk, 1 = scatter):
+// mode 0 = CMA, 1 = TCP, -1 = release to the adaptive router. Ranks
+// BELOW the user's env pin (DDSTORE_CMA_BULK/SCATTER) and is released
+// by UpdatePeer (the plan was against the old peer set).
+int dds_sched_pin_route(dds_handle* h, int cls, int mode) {
+  if (!h || !h->tcp) return dds::kErrInvalidArg;
+  return h->tcp->PinRoute(cls, mode);
+}
+
+// Planner lane-width pin for one traffic class: lanes >= 1 pins the
+// stripe width (clamped to the pool size), -1 releases to the lane
+// autotuner. Same env-pin/UpdatePeer ranking as the route pin.
+int dds_sched_pin_lanes(dds_handle* h, int cls, int lanes) {
+  if (!h || !h->tcp) return dds::kErrInvalidArg;
+  return h->tcp->PinLanes(cls, lanes);
+}
+
+// Async admission width (how many async batched reads run at once):
+// n >= 1 overrides, n <= 0 restores the DDSTORE_ASYNC_THREADS /
+// core-ladder default. Valid for every backend (the async engine is
+// store-level).
+int dds_set_async_width(dds_handle* h, int n) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->SetAsyncWidth(n);
+}
+
+int dds_async_width(dds_handle* h) {
+  return h ? h->store->AsyncWidth() : dds::kErrInvalidArg;
+}
+
 // Per-store retry-deadline override (seconds; <= 0 clears). The
 // degraded readahead path shares one OP_DEADLINE budget across a
 // window give-up and its per-batch refetch through this; other stores

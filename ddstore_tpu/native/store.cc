@@ -4,6 +4,7 @@
 #include <chrono>
 #include <cstring>
 #include <memory>
+#include <thread>
 
 #include "worker_pool.h"
 
@@ -19,13 +20,28 @@ double MonoSeconds() {
       .count();
 }
 
+// Thread cap of the (lazily created) async pool. The ADMISSION width —
+// how many reads actually run at once — is enforced separately in
+// SubmitAsync/PumpAsyncLocked, so this only needs to cover the largest
+// width the scheduler may ever set (threads are created lazily; an
+// unused cap costs nothing).
+constexpr int kAsyncPoolCap = 16;
+
 long AsyncThreadsFromEnv() {
   if (const char* env = std::getenv("DDSTORE_ASYNC_THREADS")) {
     char* end = nullptr;
     long v = std::strtol(env, &end, 10);
-    if (end != env && v > 0) return v < 16 ? v : 16;
+    if (end != env && v > 0)
+      return v < kAsyncPoolCap ? v : kAsyncPoolCap;
   }
-  return 2;
+  // Default from the core count — the same 4/2/1 ladder the transport
+  // lane pool uses (tcp_transport.cc): admission width and lane fan-out
+  // compete for the same cores, so they scale by the same rule. One
+  // in-flight window is the readahead steady state; extra slots absorb
+  // a co-variable (labels) and deeper rings, but only pay where there
+  // are cores to run them.
+  const unsigned hw = std::thread::hardware_concurrency();
+  return hw >= 8 ? 4 : (hw >= 4 ? 2 : 1);
 }
 }  // namespace
 
@@ -48,7 +64,12 @@ const char* ErrorString(int code) {
 }
 
 Store::Store(std::unique_ptr<Transport> transport)
-    : transport_(std::move(transport)) {}
+    : transport_(std::move(transport)),
+      // Resolved once per store (the pre-admission-gate code read the
+      // env once at pool creation): AsyncWidth() runs on the async
+      // issue/completion hot path under async_mu_ and must not
+      // getenv/strtol there.
+      async_default_(static_cast<int>(AsyncThreadsFromEnv())) {}
 
 Store::~Store() {
   // In-flight async reads hold the shared lock and use the transport;
@@ -61,6 +82,15 @@ void Store::DrainAsync() {
   std::unique_ptr<WorkerPool> pool;
   {
     std::lock_guard<std::mutex> lock(async_mu_);
+    // Admission-deferred reads must still complete — a waiter in
+    // AsyncRelease blocks on their AsyncState. Hand them all to the
+    // pool (ignoring the width; this is teardown): its dtor runs every
+    // queued task before joining.
+    while (!async_deferred_.empty()) {
+      ++async_running_;
+      async_pool_->Submit(std::move(async_deferred_.front()));
+      async_deferred_.pop_front();
+    }
     pool = std::move(async_pool_);
     async_.clear();  // workers hold their AsyncState via shared_ptr
   }
@@ -408,35 +438,71 @@ int Store::RetryTransient(const std::function<int()>& call, int target) {
       retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9);
 }
 
+int Store::AsyncWidth() const {
+  const int w = async_width_override_.load(std::memory_order_relaxed);
+  if (w >= 1) return w < kAsyncPoolCap ? w : kAsyncPoolCap;
+  return async_default_;
+}
+
+int Store::SetAsyncWidth(int n) {
+  async_width_override_.store(n >= 1 ? n : 0, std::memory_order_relaxed);
+  // A raise must admit reads already waiting for a slot.
+  std::lock_guard<std::mutex> lock(async_mu_);
+  PumpAsyncLocked();
+  return kOk;
+}
+
+void Store::PumpAsyncLocked() {
+  while (async_pool_ && !async_deferred_.empty() &&
+         async_running_ < AsyncWidth()) {
+    ++async_running_;
+    async_pool_->Submit(std::move(async_deferred_.front()));
+    async_deferred_.pop_front();
+  }
+}
+
 int64_t Store::SubmitAsync(std::function<int()> fn) {
   auto st = std::make_shared<AsyncState>();
   int64_t ticket;
   {
     std::lock_guard<std::mutex> lock(async_mu_);
     if (!async_pool_) {
-      // Default 2 threads: one window in flight is the steady state
-      // (the ring keeps window N+1 fetching while N is consumed); the
-      // second absorbs a co-variable (labels) issued alongside. Each
-      // async read's lane fan-out happens INSIDE the transport pool, so
-      // this count stays the stripe-scheduling admission width — how
-      // many window reads may contend for lanes at once.
-      // DDSTORE_ASYNC_THREADS raises it for deep (depth > 2) rings.
-      async_pool_.reset(
-          new WorkerPool(static_cast<int>(AsyncThreadsFromEnv())));
+      // The pool's thread cap is fixed and generous (threads spawn
+      // lazily); the ADMISSION width — how many reads run at once,
+      // i.e. how many window fetches may contend for the transport's
+      // lanes/cores — is enforced below via async_running_, so the
+      // scheduler can change it at runtime (SetAsyncWidth). One window
+      // in flight is the readahead steady state (the ring keeps window
+      // N+1 fetching while N is consumed); extra width absorbs a
+      // co-variable (labels) and deeper rings. Each read's lane
+      // fan-out happens INSIDE the transport pool.
+      async_pool_.reset(new WorkerPool(kAsyncPoolCap));
     }
     ticket = next_ticket_++;
     async_[ticket] = st;
+    auto task = [this, fn = std::move(fn), st]() {
+      int rc = fn();
+      {
+        std::lock_guard<std::mutex> lock(st->mu);
+        st->rc = rc;
+        st->done_mono_s = MonoSeconds();
+        st->done = true;
+      }
+      st->cv.notify_all();
+      // Free the admission slot and start the next deferred read.
+      // async_pool_ is stable once created (only DrainAsync moves it,
+      // and callers must not race teardown with new issues).
+      std::lock_guard<std::mutex> lock(async_mu_);
+      --async_running_;
+      PumpAsyncLocked();
+    };
+    if (async_running_ < AsyncWidth()) {
+      ++async_running_;
+      async_pool_->Submit(std::move(task));
+    } else {
+      async_deferred_.push_back(std::move(task));
+    }
   }
-  // async_pool_ is stable once created (only DrainAsync moves it, and
-  // callers must not race teardown with new issues).
-  async_pool_->Submit([fn = std::move(fn), st]() {
-    int rc = fn();
-    std::lock_guard<std::mutex> lock(st->mu);
-    st->rc = rc;
-    st->done_mono_s = MonoSeconds();
-    st->done = true;
-    st->cv.notify_all();
-  });
   return ticket;
 }
 

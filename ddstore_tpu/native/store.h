@@ -18,9 +18,11 @@
 #ifndef DDSTORE_TPU_STORE_H_
 #define DDSTORE_TPU_STORE_H_
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
+#include <deque>
 #include <functional>
 #include <map>
 #include <memory>
@@ -323,6 +325,17 @@ class Store {
   // teardown leaves this at 0.
   int64_t AsyncPending() const;
 
+  // Async admission width — how many async batched reads may be RUNNING
+  // (contending for the transport's lanes/cores) at once; excess issues
+  // queue store-side and start as running ones complete, so the ticket
+  // contract is unchanged. This is the cost-model scheduler's "width"
+  // knob: n >= 1 overrides, n <= 0 restores the DDSTORE_ASYNC_THREADS /
+  // core-ladder default. Takes effect on the next issue/completion (a
+  // width raise also pumps the deferred queue immediately).
+  int SetAsyncWidth(int n);
+  // The width currently admitting (override, env, or ladder default).
+  int AsyncWidth() const;
+
   // Metadata query: total rows across all ranks (reference `query`,
   // src/ddstore.cxx:46-49) plus shape info.
   int Query(const std::string& name, int64_t* total_rows, int64_t* disp,
@@ -438,12 +451,21 @@ class Store {
                const std::vector<int64_t>& nbytes);
   // Shared issue half of GetBatchAsync/ReadRunsAsync.
   int64_t SubmitAsync(std::function<int()> fn);
+  // Admit the next deferred async reads while running < width. Caller
+  // holds async_mu_.
+  void PumpAsyncLocked();
   mutable std::mutex async_mu_;
   int64_t next_ticket_ = 1;
   std::map<int64_t, std::shared_ptr<AsyncState>> async_;
-  std::unique_ptr<WorkerPool> async_pool_;  // lazily created;
-  // DDSTORE_ASYNC_THREADS wide (default 2) — the admission width for
-  // concurrent window reads contending for the transport's lanes
+  std::unique_ptr<WorkerPool> async_pool_;  // lazily created, at a fixed
+  // generous thread cap; the ADMISSION width (how many reads run at
+  // once) is enforced here via async_running_/async_deferred_ so the
+  // scheduler can change it at runtime (SetAsyncWidth). Default width:
+  // DDSTORE_ASYNC_THREADS, else the 4/2/1 core ladder.
+  std::atomic<int> async_width_override_{0};  // 0 = env/ladder default
+  int async_default_ = 2;  // env/ladder default, resolved at construction
+  int async_running_ = 0;  // reads admitted to the pool (async_mu_)
+  std::deque<std::function<void()>> async_deferred_;  // awaiting a slot
 };
 
 }  // namespace dds

@@ -411,14 +411,13 @@ TcpTransport::TcpTransport(int rank, int world, int port)
     const char* at = ::getenv("DDSTORE_TCP_LANES_AUTOTUNE");
     const bool autotune = !at || std::strtol(at, nullptr, 10) != 0;
     scatter_lanes_.name = "scatter";
+    scatter_lanes_.cls = 1;
     for (LaneTuner* t : {&bulk_lanes_, &scatter_lanes_}) {
       t->autotune = autotune;
       for (int l = 1; l < static_cast<int>(nconn); l *= 2)
         t->levels.push_back(l);
       t->levels.push_back(static_cast<int>(nconn));
-      t->bw.assign(t->levels.size(), 0.0);
-      t->n.assign(t->levels.size(), 0);
-      t->warmed.assign(t->levels.size(), false);
+      t->stats.assign(t->levels.size(), WarmStat{});
       if (!autotune || nconn <= 1) {
         t->parked = true;
         t->active = static_cast<int>(nconn);
@@ -564,10 +563,10 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
     // that the every-16th probe would need many windows to overturn.
     std::lock_guard<std::mutex> lock(route_mu_);
     for (RouteClass* rc : {&bulk_route_, &scatter_route_}) {
-      rc->cma_bw = rc->tcp_bw = 0.0;
-      rc->cma_n = rc->tcp_n = rc->cold_skips = 0;
+      rc->cma.Reset();
+      rc->tcp.Reset();
+      rc->cold_skips = 0;
       rc->discard_probe = false;
-      rc->cma_warmed = rc->tcp_warmed = false;
       // Re-measurement from scratch includes the one-shot calibration:
       // leaving it latched would route the fresh estimates through the
       // hysteresis band only, re-introducing the parked-inside-the-band
@@ -586,12 +585,15 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
         t->level = 0;
         t->cold_skips = 0;
         t->samples = 0;
-        std::fill(t->bw.begin(), t->bw.end(), 0.0);
-        std::fill(t->n.begin(), t->n.end(), 0);
-        std::fill(t->warmed.begin(), t->warmed.end(), false);
+        for (WarmStat& s : t->stats) s.Reset();
       }
     }
   }
+  // Planner pins were computed against the old peer set too; release
+  // them so the adaptive tuners own the knobs until the scheduler's
+  // peer-change replan re-applies a fresh plan.
+  for (std::atomic<int>& p : route_pin_) p.store(-1);
+  for (std::atomic<int>& p : lane_pin_) p.store(-1);
   return kOk;
 }
 
@@ -1226,22 +1228,33 @@ constexpr int64_t kBulkBytes = 8 << 20;
 // carries that overhead cheaper is a property of the kernel/NIC, not of
 // the bulk bandwidth — measured separately.
 constexpr int64_t kScatterMinOps = 64;
-// Clean warm samples each path needs before the router stops collecting
-// (shared by RouteViaTcp's collection phase and RecordRouteSample's
-// one-shot calibration).
-constexpr int kMinRouteSamples = 2;
-
 bool TcpTransport::RouteViaTcp(RouteClass& rc) {
   // The pin env ("1" = always CMA, "0" = always TCP) is read per call so
-  // benches/tests can flip it at runtime.
+  // benches/tests can flip it at runtime. The USER pin outranks the
+  // planner pin, which outranks the adaptive estimate.
   if (const char* env = ::getenv(rc.pin_env)) {
     if (env[0] == '1') return false;
     if (env[0] == '0') return true;
   }
+  const int pin = route_pin_[rc.cls].load(std::memory_order_relaxed);
   std::lock_guard<std::mutex> lock(route_mu_);
   const int64_t d = rc.decisions++;
+  if (pin >= 0) {
+    // A planner pin decides the route but must NOT freeze the
+    // substrate: keep the steady-state probe cadence below (a paired
+    // window on the other path every 32 decisions, the pair's first
+    // discarded) so BOTH cells stay fresh and the next replan judges
+    // live numbers — a pin that also stopped probing would re-confirm
+    // itself from frozen data forever. Only the USER env pin above is
+    // absolute (forced-path benches rely on exact forcing).
+    const int phase = static_cast<int>(d & 31);
+    if (phase == 30) rc.discard_probe = true;
+    const bool probe = phase >= 30;
+    const bool pinned_tcp = pin == 1;
+    return probe ? !pinned_tcp : pinned_tcp;
+  }
   // Sample collection: alternate onto whichever path is under-sampled
-  // until BOTH have kMinRouteSamples clean measurements. One sample per
+  // until BOTH have kWarmMinSamples clean measurements. One sample per
   // path is not a comparison — the first TCP window used to pay
   // connection setup and park the verdict on a number ~6x under the warm
   // path (and connect-tainted windows are now discarded entirely, see
@@ -1251,8 +1264,8 @@ bool TcpTransport::RouteViaTcp(RouteClass& rc) {
   // alternating: an isolated window on a path that just sat idle times
   // the re-warm (TCP slow-start restart, sleeping pool threads), and
   // alternation makes EVERY collection window isolated.
-  if (rc.cma_n < kMinRouteSamples) return false;
-  if (rc.tcp_n < kMinRouteSamples) return true;
+  if (rc.cma.n < kWarmMinSamples) return false;
+  if (rc.tcp.n < kWarmMinSamples) return true;
   // Steady state: periodically probe the non-preferred path so a stale
   // estimate can recover (e.g. the kernel's CMA emulation cost changing,
   // or socket buffers autotuning up). Probes come as a PAIR of
@@ -1281,32 +1294,18 @@ void TcpTransport::RecordRouteSample(RouteClass& rc, bool via_tcp,
   if (bytes <= 0 || secs <= 0.0) return;
   const double bw = static_cast<double>(bytes) / secs;
   std::lock_guard<std::mutex> lock(route_mu_);
-  // A window that dialed a connection timed the handshake, not the
-  // transport. While the path has no clean sample yet, discard it and
-  // let collection re-probe (bounded: a peer set that reconnects every
-  // read must not pin collection mode forever — after 4 discards the
-  // tainted number beats having none).
-  if (cold && (via_tcp ? rc.tcp_n : rc.cma_n) == 0 && rc.cold_skips < 4) {
-    ++rc.cold_skips;
+  // Hygiene is the shared substrate's (measure.h): dial-tainted
+  // windows discarded while the cell is unseeded (bounded by the
+  // class-shared skip budget), each cell's first clean window consumed
+  // as its warm-up, and the armed probe-pair discard eaten by the next
+  // non-preferred-path sample (the pair's first window only re-warmed
+  // the idle path; the one after it is the measurement).
+  WarmStat& cell = via_tcp ? rc.tcp : rc.cma;
+  bool* probe = via_tcp != rc.via_tcp ? &rc.discard_probe : nullptr;
+  if (FoldWarmSample(cell, bw, cold, &rc.cold_skips, probe) !=
+      WarmFold::kFolded)
     return;
-  }
-  // Each path's first (clean) window is a warm-up: it timed the path
-  // waking, not running. Discard it so the seed estimate starts warm.
-  bool& warmed = via_tcp ? rc.tcp_warmed : rc.cma_warmed;
-  if (!warmed) {
-    warmed = true;
-    return;
-  }
-  // The warm-up half of a probe pair: this window only re-warmed the
-  // idle non-preferred path; the NEXT window on it is the measurement.
-  if (rc.discard_probe && via_tcp != rc.via_tcp) {
-    rc.discard_probe = false;
-    return;
-  }
-  (via_tcp ? rc.tcp_n : rc.cma_n)++;
-  double& est = via_tcp ? rc.tcp_bw : rc.cma_bw;
-  est = est == 0.0 ? bw : 0.5 * est + 0.5 * bw;
-  if (rc.cma_bw == 0.0 || rc.tcp_bw == 0.0) return;
+  if (rc.cma.ewma == 0.0 || rc.tcp.ewma == 0.0) return;
   // One-shot warm calibration: the first moment BOTH paths hold clean
   // warm estimates, park the class on the measured-faster one outright.
   // Hysteresis exists to stop steady-state flapping between paths the
@@ -1314,16 +1313,16 @@ void TcpTransport::RecordRouteSample(RouteClass& rc, bool via_tcp,
   // parked a cold start on whichever path happened to be the default
   // whenever the faster one won by less than the band.
   bool flip_to_tcp, flip_to_cma;
-  if (!rc.calibrated && rc.cma_n >= kMinRouteSamples &&
-      rc.tcp_n >= kMinRouteSamples) {
+  if (!rc.calibrated && rc.cma.n >= kWarmMinSamples &&
+      rc.tcp.n >= kWarmMinSamples) {
     rc.calibrated = true;
-    flip_to_tcp = !rc.via_tcp && rc.tcp_bw > rc.cma_bw;
-    flip_to_cma = rc.via_tcp && rc.cma_bw > rc.tcp_bw;
+    flip_to_tcp = !rc.via_tcp && rc.tcp.ewma > rc.cma.ewma;
+    flip_to_cma = rc.via_tcp && rc.cma.ewma > rc.tcp.ewma;
   } else {
     // Per-class hysteresis: flapping between near-equal paths costs
     // probes and log noise for no bandwidth (1.25x bulk, 1.1x scatter).
-    flip_to_tcp = !rc.via_tcp && rc.tcp_bw > rc.hysteresis * rc.cma_bw;
-    flip_to_cma = rc.via_tcp && rc.cma_bw > rc.hysteresis * rc.tcp_bw;
+    flip_to_tcp = !rc.via_tcp && rc.tcp.ewma > rc.hysteresis * rc.cma.ewma;
+    flip_to_cma = rc.via_tcp && rc.cma.ewma > rc.hysteresis * rc.tcp.ewma;
   }
   if (flip_to_tcp || flip_to_cma) {
     rc.via_tcp = flip_to_tcp;
@@ -1332,7 +1331,7 @@ void TcpTransport::RecordRouteSample(RouteClass& rc, bool via_tcp,
                  "[dds r%d] %s reads now routed via %s (CMA %.2f GB/s "
                  "vs TCP %.2f GB/s)\n",
                  rank_, rc.name, flip_to_tcp ? "TCP" : "CMA",
-                 rc.cma_bw / 1e9, rc.tcp_bw / 1e9);
+                 rc.cma.ewma / 1e9, rc.tcp.ewma / 1e9);
   }
 }
 
@@ -1341,18 +1340,14 @@ void TcpTransport::RoutingState(int cls, double* cma_bw, double* tcp_bw,
                                 int* via_tcp, int* calibrated) {
   std::lock_guard<std::mutex> lock(route_mu_);
   const RouteClass& rc = cls == 1 ? scatter_route_ : bulk_route_;
-  *cma_bw = rc.cma_bw;
-  *tcp_bw = rc.tcp_bw;
+  *cma_bw = rc.cma.ewma;
+  *tcp_bw = rc.tcp.ewma;
   *decisions = rc.decisions;
   *crossovers = rc.crossovers;
   *via_tcp = rc.via_tcp ? 1 : 0;
   *calibrated = rc.calibrated ? 1 : 0;
 }
 
-// Clean warm samples the tuner needs per level before judging it
-// (mirrors kMinRouteSamples; one sample per level is a wake-up
-// measurement, not a comparison).
-constexpr int kMinLaneSamples = 2;
 // A level must beat its predecessor's throughput by this factor to keep
 // the ramp going; below it, per-lane throughput has stopped scaling and
 // the extra streams are pure dispatch/syscall overhead.
@@ -1360,6 +1355,11 @@ constexpr double kLaneGrowth = 1.15;
 
 int TcpTransport::StripeLanes(LaneTuner& t) {
   std::lock_guard<std::mutex> lock(lane_mu_);
+  const int pin = lane_pin_[t.cls].load(std::memory_order_relaxed);
+  if (pin >= 1) {
+    const int pool = t.levels.empty() ? 1 : t.levels.back();
+    return pin < pool ? pin : pool;
+  }
   return t.parked ? t.active : t.levels[static_cast<size_t>(t.level)];
 }
 
@@ -1369,35 +1369,40 @@ void TcpTransport::RecordLaneSample(LaneTuner& t, int lanes,
   if (bytes <= 0 || secs <= 0.0) return;
   const double bw = static_cast<double>(bytes) / secs;
   std::lock_guard<std::mutex> lock(lane_mu_);
+  if (lane_pin_[t.cls].load(std::memory_order_relaxed) >= 1) {
+    // Planner-pinned width: ramp/park decisions are suspended, but the
+    // substrate keeps measuring — fold into the level matching the
+    // pinned width (if it is one of the tuner's levels) so a later
+    // replan sees fresh numbers for the width actually run.
+    for (size_t i = 0; i < t.levels.size(); ++i) {
+      if (t.levels[i] != lanes) continue;
+      if (FoldWarmSample(t.stats[i], bw, cold, &t.cold_skips, nullptr) ==
+          WarmFold::kFolded)
+        ++t.samples;
+      break;
+    }
+    return;
+  }
   if (t.parked) return;
   const size_t lv = static_cast<size_t>(t.level);
   // Concurrent batches (depth>1 readahead windows) can complete after
   // the level advanced; a sample measured at a different width says
   // nothing about the current level.
   if (lanes != t.levels[lv]) return;
-  // Dial-tainted windows time the handshake, not the stripe (same rule
-  // as RecordRouteSample); discard while the level is unseeded —
-  // bounded, also like the router: a peer set that redials every
-  // window (idle-closing server, sustained chaos) must not pin the
-  // ramp at level 0 forever, so after 4 discards the tainted number
-  // beats having none.
-  if (cold && t.n[lv] == 0 && t.cold_skips < 4) {
-    ++t.cold_skips;
+  // Hygiene is the shared substrate's (measure.h): dial-tainted
+  // windows discarded while the level is unseeded (per-tuner bounded
+  // budget — a peer set that redials every window must not pin the
+  // ramp at level 0 forever), and each level's first clean window
+  // consumed as its warm-up (it re-warms idle lanes/pool threads).
+  if (FoldWarmSample(t.stats[lv], bw, cold, &t.cold_skips, nullptr) !=
+      WarmFold::kFolded)
     return;
-  }
-  // Each level's first clean window re-warms idle lanes/pool threads;
-  // its sample is discarded so the estimate starts warm.
-  if (!t.warmed[lv]) {
-    t.warmed[lv] = true;
-    return;
-  }
-  t.bw[lv] = t.bw[lv] == 0.0 ? bw : 0.5 * t.bw[lv] + 0.5 * bw;
-  ++t.n[lv];
   ++t.samples;
-  if (t.n[lv] < kMinLaneSamples) return;
+  if (t.stats[lv].n < kWarmMinSamples) return;
   const bool scaled =
       t.level == 0 ||
-      t.bw[lv] > kLaneGrowth * t.bw[static_cast<size_t>(t.level - 1)];
+      t.stats[lv].ewma >
+          kLaneGrowth * t.stats[static_cast<size_t>(t.level - 1)].ewma;
   if (scaled && lv + 1 < t.levels.size()) {
     ++t.level;  // keep ramping: the last doubling still paid
     return;
@@ -1406,13 +1411,13 @@ void TcpTransport::RecordLaneSample(LaneTuner& t, int lanes,
   // park on the best-measured level outright.
   size_t best = 0;
   for (size_t i = 1; i <= lv; ++i)
-    if (t.bw[i] > t.bw[best]) best = i;
+    if (t.stats[i].ewma > t.stats[best].ewma) best = i;
   t.parked = true;
   t.active = t.levels[best];
   std::fprintf(stderr,
                "[dds r%d] %s striped reads parked at %d lane(s) "
                "(%.2f GB/s; next level %s)\n",
-               rank_, t.name, t.active, t.bw[best] / 1e9,
+               rank_, t.name, t.active, t.stats[best].ewma / 1e9,
                scaled ? "unmeasured (pool cap)" : "stopped scaling");
 }
 
@@ -1420,18 +1425,79 @@ void TcpTransport::LaneState(int64_t out[8]) {
   std::lock_guard<std::mutex> lock(lane_mu_);
   const LaneTuner& t = bulk_lanes_;
   double best = 0.0;
-  for (double b : t.bw) best = b > best ? b : best;
-  out[0] = t.levels.empty() ? 1 : t.levels.back();  // pool size
-  out[1] = t.parked ? t.active
-                    : t.levels[static_cast<size_t>(t.level)];
-  out[2] = t.parked ? 1 : 0;
+  for (const WarmStat& s : t.stats) best = s.ewma > best ? s.ewma : best;
+  const int pool = t.levels.empty() ? 1 : t.levels.back();
+  // A planner pin is what striped reads actually engage; report it as
+  // the active width (and as "parked": the ramp is suspended).
+  const int bulk_pin = lane_pin_[0].load(std::memory_order_relaxed);
+  const int sc_pin = lane_pin_[1].load(std::memory_order_relaxed);
+  out[0] = pool;
+  out[1] = bulk_pin >= 1 ? (bulk_pin < pool ? bulk_pin : pool)
+                         : (t.parked ? t.active
+                                     : t.levels[static_cast<size_t>(
+                                           t.level)]);
+  out[2] = (t.parked || bulk_pin >= 1) ? 1 : 0;
   out[3] = t.autotune ? 1 : 0;
   out[4] = t.samples + scatter_lanes_.samples;
   out[5] = static_cast<int64_t>(best);
   const LaneTuner& sc = scatter_lanes_;
-  out[6] = sc.parked ? sc.active
-                     : sc.levels[static_cast<size_t>(sc.level)];
-  out[7] = sc.parked ? 1 : 0;
+  out[6] = sc_pin >= 1 ? (sc_pin < pool ? sc_pin : pool)
+                       : (sc.parked ? sc.active
+                                    : sc.levels[static_cast<size_t>(
+                                          sc.level)]);
+  out[7] = (sc.parked || sc_pin >= 1) ? 1 : 0;
+}
+
+int TcpTransport::PinRoute(int cls, int mode) {
+  if (cls < 0 || cls > 1 || mode < -1 || mode > 1) return kErrInvalidArg;
+  route_pin_[cls].store(mode, std::memory_order_relaxed);
+  if (mode >= 0) {
+    // Align the router's preference with the pin: RecordRouteSample
+    // classifies probe-pair windows by `via_tcp != rc.via_tcp`, and
+    // the probes RouteViaTcp sends under a pin target the non-PINNED
+    // path. (Also the sane release state: dropping the pin resumes
+    // adaptive routing from the pinned path, hysteresis governing any
+    // later flip.)
+    std::lock_guard<std::mutex> lock(route_mu_);
+    (cls == 1 ? scatter_route_ : bulk_route_).via_tcp = mode == 1;
+  }
+  return kOk;
+}
+
+int TcpTransport::PinLanes(int cls, int lanes) {
+  if (cls < 0 || cls > 1 || lanes == 0 || lanes < -1 || lanes > 64)
+    return kErrInvalidArg;
+  lane_pin_[cls].store(lanes, std::memory_order_relaxed);
+  return kOk;
+}
+
+int TcpTransport::SchedCells(double* out, int cap) {
+  if (!out || cap < 0) return kErrInvalidArg;
+  int rows = 0;
+  auto put = [&](double src, double cls, double knob, const WarmStat& s) {
+    if (rows >= cap) return;
+    double* r = out + static_cast<size_t>(rows) * 5;
+    r[0] = src;
+    r[1] = cls;
+    r[2] = knob;
+    r[3] = s.ewma;
+    r[4] = static_cast<double>(s.n);
+    ++rows;
+  };
+  {
+    std::lock_guard<std::mutex> lock(route_mu_);
+    for (const RouteClass* rc : {&bulk_route_, &scatter_route_}) {
+      put(0, rc->cls, 0, rc->cma);
+      put(0, rc->cls, 1, rc->tcp);
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lock(lane_mu_);
+    for (const LaneTuner* t : {&bulk_lanes_, &scatter_lanes_})
+      for (size_t i = 0; i < t->levels.size(); ++i)
+        put(1, t->cls, t->levels[i], t->stats[i]);
+  }
+  return rows;
 }
 
 int TcpTransport::LaneBytes(int target, int64_t* out, int cap) {

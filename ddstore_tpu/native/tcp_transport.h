@@ -29,6 +29,7 @@
 #include <vector>
 
 #include "cma.h"
+#include "measure.h"
 #include "store.h"
 #include "worker_pool.h"
 
@@ -147,6 +148,25 @@ class TcpTransport : public Transport {
   // (bounded by `cap`).
   void LaneState(int64_t out[8]);
   int LaneBytes(int target, int64_t* out, int cap);
+
+  // Planner pins (the cost-model scheduler's runtime knob setters, see
+  // ddstore_tpu/sched/planner.py). A pin OVERRIDES the corresponding
+  // adaptive tuner's decision without stopping its measurement: samples
+  // keep folding into the warm-window cells so a later replan sees
+  // fresh numbers. The USER-level env pins (DDSTORE_CMA_BULK/SCATTER,
+  // DDSTORE_TCP_LANES) still rank above these — the planner never sets
+  // a pin for a knob the user froze. UpdatePeer releases both pins
+  // (they were planned against the old peer set; the scheduler replans
+  // and re-applies on its peer-change hook).
+  int PinRoute(int cls, int mode);   // mode: 0=CMA, 1=TCP, -1=release
+  int PinLanes(int cls, int lanes);  // lanes >= 1 pins width, -1 release
+
+  // Warm-window substrate snapshot for the planner: writes up to `cap`
+  // rows of 5 doubles [source (0=route, 1=lanes), cls (0=bulk,
+  // 1=scatter), knob (route: 0=cma/1=tcp; lanes: lane count),
+  // ewma_bytes_per_s, clean_samples] and returns the row count (keep in
+  // sync with binding.py SCHED_CELL_COLS).
+  int SchedCells(double* out, int cap);
 
   int Read(int target, const std::string& name, int64_t offset, int64_t nbytes,
            void* dst) override;
@@ -300,26 +320,24 @@ class TcpTransport : public Transport {
     // measurably slower path (auto_batch ~18% under the best forced
     // path in BENCH r6).
     double hysteresis = 1.25;
-    double cma_bw = 0.0;  // EWMA bytes/s; 0 = no sample yet
-    double tcp_bw = 0.0;
+    int cls = 0;  // 0 = bulk, 1 = scatter (pin/snapshot index)
+    // Per-path warm-window cells (the shared measurement substrate,
+    // measure.h): EWMA bytes/s + clean-sample count + warm-up state.
+    // The router keeps collecting until both reach kWarmMinSamples.
+    WarmStat cma;
+    WarmStat tcp;
     int64_t decisions = 0;
     int64_t crossovers = 0;  // preference flips (observability: a
     //                          flapping policy shows up as a count,
     //                          diagnosable from BENCH json alone)
-    int cma_n = 0;   // clean samples folded into each EWMA: the router
-    int tcp_n = 0;   // keeps collecting until both reach kMinRouteSamples
-    int cold_skips = 0;  // connect-tainted seeds discarded (bounded)
+    int cold_skips = 0;  // connect-tainted seeds discarded (bounded,
+    //                      shared across both cells — measure.h rule 1)
     // Probes run as consecutive PAIRS on the non-preferred path: the
     // first window re-warms it (idle TCP connections restart from
     // slow-start, pool threads sleep) and its sample is discarded; only
     // the second, warm window is folded into the EWMA. Set when the
-    // warm-up window is dispatched; cleared by RecordRouteSample.
+    // warm-up window is dispatched; consumed by FoldWarmSample (rule 3).
     bool discard_probe = false;
-    // Collection applies the same rule: each path's very first window is
-    // a warm-up whose sample is discarded, so the seed estimates are
-    // built from warm windows only.
-    bool cma_warmed = false;
-    bool tcp_warmed = false;
     bool via_tcp = false;
     // One-shot warm calibration: once BOTH paths hold clean warm
     // estimates (collection complete), the class is parked on the
@@ -328,8 +346,8 @@ class TcpTransport : public Transport {
     // sat inside the hysteresis band forever.
     bool calibrated = false;
   };
-  RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK", 1.25};
-  RouteClass scatter_route_{"scattered", "DDSTORE_CMA_SCATTER", 1.10};
+  RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK", 1.25, 0};
+  RouteClass scatter_route_{"scattered", "DDSTORE_CMA_SCATTER", 1.10, 1};
   unsigned hw_cores_ = 1;  // CMA striping is CPU-bound; never deal more
   //                          part-lists than cores (a 1-core box pays
   //                          pure dispatch overhead for each extra part)
@@ -354,17 +372,19 @@ class TcpTransport : public Transport {
   // DDSTORE_TCP_LANES_AUTOTUNE=0 pins striping at the full pool size.
   struct LaneTuner {
     const char* name = "bulk";  // log/observability label
+    int cls = 0;                // 0 = bulk, 1 = scatter (pin index)
     bool autotune = true;
     bool parked = false;
     int active = 1;            // lanes striped reads use once parked
     int level = 0;             // index into levels while measuring
     std::vector<int> levels;   // 1, 2, 4, ..., max_lanes
-    std::vector<double> bw;    // per-level EWMA bytes/s
-    std::vector<int> n;        // clean warm samples folded per level
-    std::vector<bool> warmed;  // per-level warm-up window consumed
+    // Per-level warm-window cells (shared substrate, measure.h): EWMA
+    // bytes/s, clean samples, warm-up state per lane count.
+    std::vector<WarmStat> stats;
     int cold_skips = 0;        // dial-tainted windows discarded (bounded
     //                            like the router's: a peer that redials
-    //                            every window must not pin the ramp)
+    //                            every window must not pin the ramp —
+    //                            measure.h rule 1, per-tuner budget)
     int64_t samples = 0;       // clean samples folded (observability)
   };
   std::mutex lane_mu_;
@@ -392,6 +412,12 @@ class TcpTransport : public Transport {
   // overturn).
   void RecordRouteSample(RouteClass& rc, bool via_tcp, int64_t bytes,
                          double secs, bool cold = false);
+
+  // Planner pins, one per traffic class (see PinRoute/PinLanes above).
+  // route: -1 = adaptive, 0 = CMA, 1 = TCP. lanes: -1 = tuner, >= 1 =
+  // pinned stripe width (clamped to the pool size at use).
+  std::atomic<int> route_pin_[2]{-1, -1};
+  std::atomic<int> lane_pin_[2]{-1, -1};
 
   // Connections dialed so far (EnsureConnected establishing a fresh
   // socket). The TCP read leg snapshots it around its timed window to

@@ -1,0 +1,163 @@
+"""Tunable-knob registry: every ``DDSTORE_*`` environment variable this
+codebase documents, classified by how the cost-model scheduler treats
+it.
+
+The scheduler plans four knobs jointly (route x lanes x readahead depth
+x async width); an env var that USED to be the only way to set one of
+them is now a **pin** — explicitly setting it freezes that knob at the
+user's value and the planner plans the rest. Everything else is plain
+configuration the planner must not touch.
+
+``tests/test_sched.py`` holds the drift guard: every ``DDSTORE_*`` name
+appearing in README.md or MIGRATION.md must be registered here, so a
+new knob cannot silently bypass the scheduler (it either pins a planned
+knob or is consciously classified as config).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+#: The jointly planned knobs (see :mod:`ddstore_tpu.sched.planner`).
+PLANNED_KNOBS = ("route_bulk", "route_scatter", "lanes_bulk",
+                 "lanes_scatter", "depth", "width")
+
+
+@dataclass(frozen=True)
+class Knob:
+    env: str
+    #: ``"pin"`` — setting this env freezes one of the planned knobs;
+    #: ``"config"`` — plain configuration, never planned.
+    kind: str
+    #: Which :data:`PLANNED_KNOBS` entries an explicit value freezes
+    #: (pins only).
+    pins: tuple = ()
+    description: str = ""
+
+
+def _k(env: str, kind: str, pins: tuple = (), desc: str = "") -> Knob:
+    return Knob(env, kind, pins, desc)
+
+
+#: env name -> Knob. Keep sorted within each block.
+REGISTRY: Dict[str, Knob] = {k.env: k for k in [
+    # -- pins of planned knobs -------------------------------------------
+    _k("DDSTORE_ASYNC_THREADS", "pin", ("width",),
+       "async admission width; unset = 4/2/1 core ladder, planned"),
+    _k("DDSTORE_CMA_BULK", "pin", ("route_bulk",),
+       "1 = force CMA, 0 = force TCP for bulk reads"),
+    _k("DDSTORE_CMA_SCATTER", "pin", ("route_scatter",),
+       "1 = force CMA, 0 = force TCP for scatter reads"),
+    _k("DDSTORE_CONNS_PER_PEER", "pin", ("lanes_bulk", "lanes_scatter"),
+       "legacy alias of DDSTORE_TCP_LANES"),
+    _k("DDSTORE_READAHEAD_DEPTH", "pin", ("depth",),
+       "readahead windows in flight; unset = planned (bounded by the "
+       "loader's readahead_windows argument)"),
+    _k("DDSTORE_TCP_LANES", "pin", ("lanes_bulk", "lanes_scatter"),
+       "per-peer connection pool size; explicit value pins stripe "
+       "width"),
+    _k("DDSTORE_TCP_LANES_AUTOTUNE", "pin",
+       ("lanes_bulk", "lanes_scatter"),
+       "0 pins striping at the full pool size"),
+    # -- configuration (never planned) -----------------------------------
+    _k("DDSTORE_BACKEND", "config", desc="local/tcp backend select"),
+    _k("DDSTORE_BARRIER_TIMEOUT_S", "config"),
+    _k("DDSTORE_BENCH_DEADLINE_S", "config"),
+    _k("DDSTORE_BENCH_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_BENCH_PROBE_TIMEOUT_S", "config"),
+    _k("DDSTORE_BENCH_SKIP_PROBE", "config"),
+    _k("DDSTORE_CHAOS_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_CMA", "config", desc="0 disables the CMA fast path "
+       "entirely (a capability switch, not a per-class preference)"),
+    _k("DDSTORE_CONNECT_TIMEOUT_S", "config"),
+    _k("DDSTORE_COORDINATOR", "config"),
+    _k("DDSTORE_DEBUG", "config"),
+    _k("DDSTORE_DRYRUN_TIMEOUT_S", "config"),
+    _k("DDSTORE_FAULT_RANKS", "config"),
+    _k("DDSTORE_FAULT_SEED", "config"),
+    _k("DDSTORE_FAULT_SPEC", "config"),
+    _k("DDSTORE_HOST", "config"),
+    _k("DDSTORE_IFACES", "config"),
+    _k("DDSTORE_LANES_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_METHOD", "config"),
+    _k("DDSTORE_OP_DEADLINE_S", "config"),
+    _k("DDSTORE_PEAK_FLOPS", "config"),
+    _k("DDSTORE_POD_AUTODETECT", "config"),
+    _k("DDSTORE_POOL_THREADS", "config"),
+    _k("DDSTORE_PPSCHED_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_RANK", "config"),
+    _k("DDSTORE_RDV_DIR", "config"),
+    _k("DDSTORE_RDV_ID", "config"),
+    _k("DDSTORE_READ_TIMEOUT_S", "config"),
+    _k("DDSTORE_RETRY_BASE_MS", "config"),
+    _k("DDSTORE_RETRY_MAX", "config"),
+    _k("DDSTORE_SANITIZE", "config"),
+    _k("DDSTORE_SCHED", "config",
+       desc="0 disables the cost-model scheduler (independent tuners "
+            "only); default on"),
+    _k("DDSTORE_SCHED_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_SOAK_BUDGET_S", "config"),
+    _k("DDSTORE_SOAK_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_UDS", "config"),
+    _k("DDSTORE_WORLD", "config"),
+]}
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def pinned_knobs(env: Optional[dict] = None) -> Dict[str, object]:
+    """The planned knobs the USER froze via env vars, with their pinned
+    values — the planner plans everything NOT in this dict.
+
+    Returns a subset of :data:`PLANNED_KNOBS` keys: routes map to
+    ``"cma"``/``"tcp"``, lanes to an int width (``"pool"`` when only
+    autotune was turned off — pinned at the pool size), depth/width to
+    ints."""
+    e = os.environ if env is None else env
+    pins: Dict[str, object] = {}
+    for cls, var in (("route_bulk", "DDSTORE_CMA_BULK"),
+                     ("route_scatter", "DDSTORE_CMA_SCATTER")):
+        v = e.get(var, "").strip()
+        if v.startswith("1"):
+            pins[cls] = "cma"
+        elif v.startswith("0"):
+            pins[cls] = "tcp"
+    lanes = None
+    for var in ("DDSTORE_TCP_LANES", "DDSTORE_CONNS_PER_PEER"):
+        v = e.get(var, "").strip()
+        if v:
+            try:
+                lanes = int(v)
+            except ValueError:
+                lanes = None
+            break
+    if lanes is not None:
+        pins["lanes_bulk"] = pins["lanes_scatter"] = lanes
+    elif e.get("DDSTORE_TCP_LANES_AUTOTUNE", "").strip() == "0":
+        # Autotune off with no explicit width: striping is pinned at
+        # the (core-ladder) pool size — still a user decision the
+        # planner must not override.
+        pins["lanes_bulk"] = pins["lanes_scatter"] = "pool"
+    v = e.get("DDSTORE_ASYNC_THREADS", "").strip()
+    if v:
+        try:
+            pins["width"] = int(v)
+        except ValueError:
+            pass
+    v = e.get("DDSTORE_READAHEAD_DEPTH", "").strip()
+    if v:
+        try:
+            pins["depth"] = int(v)
+        except ValueError:
+            pass
+    return pins

@@ -1,0 +1,425 @@
+"""Cost-model scheduler: joint route x lanes x depth x width planning.
+
+Before this module the port carried three INDEPENDENT warm-window
+tuners — the CMA/TCP router, the per-class lane autotuner, and hand-set
+readahead depth / async admission width — each optimizing its knob
+blind to the others. The knobs are not independent: lane fan-out,
+async admission and window depth all compete for the same cores (PR 5's
+honest finding: on a 2-core box 1-lane fan-out alone oversubscribes the
+CPU, and scatter forced to 4 lanes ran at 0.33x). This planner models
+delivered batch throughput as one function of all four knobs per
+traffic class and plans them together.
+
+The model
+---------
+
+Per traffic class ``c`` (bulk / scatter), candidate route ``r`` and
+lane width ``l``::
+
+    T(c, r, l)      = B(c, r, l) * g(l)          predicted fetch bytes/s
+    B(c, r, l)      = the substrate's measured EWMA for that cell when
+                      it holds >= WARM_MIN_SAMPLES clean samples;
+                      otherwise extrapolated from the nearest measured
+                      width l0 of the same (c, r)
+    g(l | l0)       = max(1, min(l / l0, cores / (l0 * peers)))
+                      the CORE-BUDGET term: widening a stripe l0 -> l
+                      scales linearly in the lane ratio only while idle
+                      cores cover the extra streams; with cores <=
+                      l0 * peers there is no headroom and the predicted
+                      gain is exactly 1 — the no-headroom regime falls
+                      out of the model, it is not special-cased.
+
+Measured beats extrapolated: a width the substrate has really measured
+uses its EWMA directly, which is how the PR 5 scatter result (4 lanes
+measured at 0.33x of 1 lane) keeps scatter on 1 lane without any
+special case. Ties break toward FEWER lanes (cheaper dispatch).
+
+Depth and width close the loop on the same core budget::
+
+    width = min(nvars * max(1, depth_req - 1),     reads the ring can
+                max(1, cores // peers),            actually keep in
+                ASYNC_WIDTH_CAP)                   flight vs. afford
+    depth = min(depth_req, width + 1)
+
+one window being consumed plus ``width`` concurrently fetching is the
+most the admission gate lets the ring exploit; deeper rings only add
+staging memory.
+
+Pin semantics
+-------------
+
+Every pre-existing env knob is a PIN (:mod:`ddstore_tpu.sched.knobs`):
+an explicitly-set ``DDSTORE_TCP_LANES`` / ``DDSTORE_CMA_*`` /
+``DDSTORE_ASYNC_THREADS`` / ``DDSTORE_READAHEAD_DEPTH`` freezes that
+knob at the user's value and the planner plans the rest. That is what
+keeps every PR 1-5 contract byte-identical under the scheduler: the
+lanes=1 identity tests, the chaos determinism runs and the forced-path
+benches all pin the knobs they rely on.
+
+Replanning
+----------
+
+The scheduler replans (and re-applies the unpinned knobs through the
+native pin setters) on epoch boundaries, on degradation events
+(``kErrPeerLost`` classification, a readahead/collective ladder
+engagement) and on peer topology changes (``update_peer`` — which also
+RESETS the native tuners and releases the planner pins, so the rebuilt
+plan starts from fresh samples). Each replan's chosen knobs, predicted
+throughput and trigger reason export through
+``PipelineMetrics.summary()["sched"]``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .knobs import pinned_knobs
+from .measure import WARM_MIN_SAMPLES, SampleSet
+
+#: Hard cap on the planned async admission width (mirrors the native
+#: pool cap, kAsyncPoolCap).
+ASYNC_WIDTH_CAP = 16
+
+_ROUTE_SRC, _LANES_SRC = 0, 1
+_CLS = {"bulk": 0, "scatter": 1}
+#: Per-class route flip bands, mirroring the native router's
+#: RouteClass.hysteresis: the planner's FIRST route verdict is a raw
+#: argmax (the router's one-shot calibration), but overturning an
+#: already-applied pin requires beating it by this factor — a raw
+#: argmax re-applied every epoch would flap between near-equal paths,
+#: exactly what the router's band exists to stop.
+_ROUTE_HYSTERESIS = {"bulk": 1.25, "scatter": 1.10}
+
+
+def scheduler_enabled(env: Optional[dict] = None) -> bool:
+    """DDSTORE_SCHED gate: default on; \"0\" disables (independent
+    tuners only — the PR 1-5 behavior)."""
+    e = os.environ if env is None else env
+    return e.get("DDSTORE_SCHED", "").strip() != "0"
+
+
+@dataclass
+class Plan:
+    """One joint knob assignment. ``None`` = knob left to its adaptive
+    tuner (insufficient samples) or frozen by a user pin (see
+    ``pins``)."""
+
+    route: Dict[str, Optional[str]] = field(
+        default_factory=lambda: {"bulk": None, "scatter": None})
+    lanes: Dict[str, Optional[int]] = field(
+        default_factory=lambda: {"bulk": None, "scatter": None})
+    depth: Optional[int] = None
+    width: Optional[int] = None
+    predicted_gbps: Dict[str, float] = field(default_factory=dict)
+    pins: Dict[str, object] = field(default_factory=dict)
+    reason: str = ""
+    #: True once apply() actually set at least one knob.
+    engaged: bool = False
+
+
+class CostModel:
+    """The throughput model over the substrate's cells (module
+    docstring). Pure and stateless beyond its geometry so the planner
+    units can drive it with canned samples."""
+
+    def __init__(self, cores: int, peers: int):
+        self.cores = max(1, int(cores))
+        self.peers = max(1, int(peers))
+
+    def core_budget_gain(self, l0: int, l: int) -> float:
+        """Extrapolated speedup of widening a stripe l0 -> l: linear in
+        the lane ratio, capped by idle-core availability (and never a
+        predicted LOSS — an unmeasured narrower width is not predicted
+        to beat a measured one)."""
+        if l <= l0:
+            return 1.0
+        want = l / l0
+        have = self.cores / (l0 * self.peers)
+        return max(1.0, min(want, have))
+
+    def lane_throughput(self, cells: Dict[int, dict],
+                        l: int) -> Optional[float]:
+        """Predicted bytes/s at width ``l`` from the class's lane cells
+        ({lane_count: row}). Measured widths (n >= WARM_MIN_SAMPLES)
+        use their EWMA; unmeasured ones extrapolate from the nearest
+        measured width below (or the nearest above, gain 1)."""
+        measured = {k: c["ewma_bps"] for k, c in cells.items()
+                    if c["n"] >= WARM_MIN_SAMPLES and c["ewma_bps"] > 0}
+        if not measured:
+            return None
+        if l in measured:
+            return measured[l]
+        below = [k for k in measured if k < l]
+        l0 = max(below) if below else min(measured)
+        return measured[l0] * self.core_budget_gain(l0, l)
+
+    def best_lanes(self, cells: Dict[int, dict]) -> Optional[int]:
+        """argmax over the tuner's widths of the predicted throughput,
+        ties toward fewer lanes. None without any measured cell."""
+        if not cells:
+            return None
+        best, best_t = None, -1.0
+        for l in sorted(cells):
+            t = self.lane_throughput(cells, l)
+            if t is None:
+                return None
+            if t > best_t * 1.0001:  # strict: ties keep fewer lanes
+                best, best_t = l, t
+        return best
+
+    def plan_width(self, nvars: int, depth_req: int) -> int:
+        useful = max(1, int(nvars)) * max(1, int(depth_req) - 1)
+        affordable = max(1, self.cores // self.peers)
+        return max(1, min(useful, affordable, ASYNC_WIDTH_CAP))
+
+    def plan_depth(self, depth_req: int, width: int) -> int:
+        return max(1, min(int(depth_req), int(width) + 1))
+
+
+class Scheduler:
+    """Owns the plan for one store + loader pairing. Thread-safe: the
+    loader's workers report degradations concurrently with the consumer
+    thread's epoch replans (replans serialize on an internal lock so
+    the applied knobs always belong to ONE jointly computed plan).
+
+    One ACTIVE scheduler per store is the supported shape — two
+    enabled schedulers pinning the same store would overwrite each
+    other's plans (last replan wins). The peer-change listener holds
+    only a weak reference, so a scheduler (and its abandoned loader)
+    is collectable and a dead one never replans.
+
+    ``requested_depth`` is the readahead ring depth the owner budgets
+    for; 0 means the owner runs NO readahead pipeline, and the
+    scheduler then leaves the depth AND async-width knobs alone (a
+    loader without readahead must not throttle the store's other
+    async users)."""
+
+    def __init__(self, store, nvars: int = 1,
+                 requested_depth: int = 2,
+                 enabled: Optional[bool] = None):
+        self.store = store
+        self.nvars = max(1, int(nvars))
+        self.requested_depth = max(0, int(requested_depth))
+        self.enabled = scheduler_enabled() if enabled is None \
+            else bool(enabled)
+        cores = os.cpu_count() or 1
+        peers = max(1, store.world - 1) if store is not None else 1
+        self.model = CostModel(cores, peers)
+        # Host-side substrate cells: delivered window-fetch throughput
+        # keyed by the depth it ran at (source "window").
+        self.samples = SampleSet()
+        self._mu = threading.Lock()
+        self._replan_mu = threading.Lock()
+        self._plan = Plan(pins=pinned_knobs())
+        self.replans = 0
+        self.reasons: List[str] = []
+        # Same regime rule the lanes bench exports: client stripe legs
+        # + serving threads of a 1-lane fan-out, + consumer + issuer.
+        self.no_core_headroom = cores < 2 * peers + 2
+        if store is not None and hasattr(store, "add_peer_listener"):
+            wr = weakref.ref(self)
+
+            def _on_peer_change():
+                s = wr()
+                if s is not None:
+                    s.on_peer_change()
+
+            # `alive` lets DDStore.update_peer prune the entry once the
+            # scheduler is collected (listener lists on long-lived
+            # stores must not grow one dead closure per discarded
+            # loader).
+            _on_peer_change.alive = lambda: wr() is not None
+            store.add_peer_listener(_on_peer_change)
+
+    # -- sample intake -----------------------------------------------------
+
+    def observe_window(self, nbytes: int, secs: float,
+                       cold: bool = False) -> None:
+        """Fold one readahead window fetch (issue -> completion) into
+        the host-side substrate, keyed by the depth it ran at. The
+        engine's FIRST window of an epoch is `cold` (ring first-touch,
+        lane dials) — the substrate's dial-taint rule discards it while
+        the cell is unseeded, exactly like the native tuners."""
+        depth = self._plan.depth or self.requested_depth or 1
+        with self._mu:
+            self.samples.fold("window", 0, depth, nbytes, secs, cold)
+
+    # -- planning ----------------------------------------------------------
+
+    def _native_cells(self) -> List[dict]:
+        if self.store is None:
+            return []
+        try:
+            return self.store.sched_cells()
+        except Exception:
+            return []
+
+    def compute(self, cells: Optional[List[dict]] = None) -> Plan:
+        """Build (but do not apply) a joint plan from substrate cells.
+        ``cells`` defaults to the live native snapshot; the planner
+        units pass canned rows."""
+        rows = self._native_cells() if cells is None else cells
+        pins = pinned_knobs()
+        plan = Plan(pins=pins)
+        for name, cls in _CLS.items():
+            route_cells = {int(r["knob"]): r for r in rows
+                           if r["source"] == _ROUTE_SRC
+                           and int(r["cls"]) == cls}
+            lane_cells = {int(r["knob"]): r for r in rows
+                          if r["source"] == _LANES_SRC
+                          and int(r["cls"]) == cls}
+            # Route: argmax over the two measured path cells. Left to
+            # the adaptive router until both paths hold clean samples
+            # (the router's own collection/calibration does that part).
+            if f"route_{name}" not in pins:
+                cma = route_cells.get(0)
+                tcp = route_cells.get(1)
+                if cma and tcp and \
+                        cma["n"] >= WARM_MIN_SAMPLES and \
+                        tcp["n"] >= WARM_MIN_SAMPLES:
+                    cma_bw, tcp_bw = cma["ewma_bps"], tcp["ewma_bps"]
+                    prev = self._plan.route.get(name)
+                    h = _ROUTE_HYSTERESIS[name]
+                    if prev is None:
+                        plan.route[name] = "tcp" if tcp_bw > cma_bw \
+                            else "cma"
+                    elif prev == "cma":
+                        plan.route[name] = "tcp" \
+                            if tcp_bw > h * cma_bw else "cma"
+                    else:
+                        plan.route[name] = "cma" \
+                            if cma_bw > h * tcp_bw else "tcp"
+            # Lanes: model argmax (measured beats extrapolated; the
+            # core-budget term caps unmeasured growth).
+            if f"lanes_{name}" not in pins:
+                plan.lanes[name] = self.model.best_lanes(lane_cells)
+            best_l = plan.lanes[name] if plan.lanes[name] else 1
+            t = self.model.lane_throughput(lane_cells, best_l) \
+                if lane_cells else None
+            if t is None and plan.route[name] is not None:
+                rc = route_cells.get(
+                    1 if plan.route[name] == "tcp" else 0)
+                t = rc["ewma_bps"] if rc else None
+            if t:
+                plan.predicted_gbps[name] = round(t / 1e9, 3)
+        # Depth/width close over the same core budget — but ONLY for an
+        # owner that actually runs a readahead pipeline
+        # (requested_depth >= 1). A readahead-less loader has no
+        # business setting the store's admission width: it would
+        # silently throttle the store's other async users.
+        if self.requested_depth >= 1:
+            width = pins.get("width")
+            if not isinstance(width, int):
+                width = self.model.plan_width(self.nvars,
+                                              self.requested_depth)
+                plan.width = width
+            depth = pins.get("depth")
+            if not isinstance(depth, int):
+                plan.depth = self.model.plan_depth(self.requested_depth,
+                                                   width)
+        return plan
+
+    def apply(self, plan: Plan) -> Plan:
+        """Push the plan's unpinned knobs through the native setters.
+        Knobs left ``None`` release the planner pin (the adaptive tuner
+        owns them again)."""
+        if self.store is None:
+            return plan
+        for name, cls in _CLS.items():
+            if f"route_{name}" not in plan.pins:
+                mode = {-1: -1, "cma": 0, "tcp": 1}[
+                    plan.route[name] if plan.route[name] else -1]
+                self.store.sched_pin_route(cls, mode)
+                plan.engaged = plan.engaged or plan.route[name] is not None
+            if f"lanes_{name}" not in plan.pins:
+                self.store.sched_pin_lanes(
+                    cls, plan.lanes[name] if plan.lanes[name] else -1)
+                plan.engaged = plan.engaged or plan.lanes[name] is not None
+        if plan.width is not None and "width" not in plan.pins:
+            self.store.set_async_width(plan.width)
+            plan.engaged = True
+        if plan.depth is not None and "depth" not in plan.pins:
+            plan.engaged = True  # consumed by the loader (planned_depth)
+        return plan
+
+    def replan(self, reason: str) -> Plan:
+        """compute + apply + record — the single entry every trigger
+        (epoch boundary, degradation, peer change) funnels through.
+        Serialized: concurrent triggers (a worker's degradation vs the
+        consumer's epoch boundary) must not interleave two plans' knob
+        writes — the store would end up with a mixed assignment
+        neither plan computed."""
+        if not self.enabled:
+            return self._plan
+        with self._replan_mu:
+            plan = self.apply(self.compute())
+            plan.reason = reason
+            with self._mu:
+                self._plan = plan
+                self.replans += 1
+                if len(self.reasons) < 64:
+                    self.reasons.append(reason)
+        return plan
+
+    # -- triggers ----------------------------------------------------------
+
+    def on_epoch(self) -> Plan:
+        return self.replan("epoch")
+
+    def on_degradation(self, what: str) -> Plan:
+        """Ladder engagement / kErrPeerLost classification: the regime
+        the plan was built for no longer holds."""
+        return self.replan(f"degraded:{what}")
+
+    def on_peer_change(self) -> Plan:
+        """update_peer released the native pins and reset the tuners;
+        rebuild (mostly releasing knobs until fresh samples land)."""
+        return self.replan("peer_change")
+
+    # -- consumption -------------------------------------------------------
+
+    def planned_depth(self, requested: int) -> int:
+        """The readahead depth the loader should run this epoch: the
+        user pin, else the plan, else the requested value — never above
+        ``requested`` (the ring the caller budgeted for)."""
+        self.requested_depth = max(1, int(requested))
+        pins = self._plan.pins
+        if isinstance(pins.get("depth"), int):
+            # A user pin is explicit — it wins even above `requested`.
+            return max(1, int(pins["depth"]))
+        if self.enabled and self._plan.depth is not None:
+            return max(1, min(self._plan.depth, self.requested_depth))
+        return self.requested_depth
+
+    def snapshot(self) -> Dict:
+        """The ``summary()["sched"]`` payload: enablement, the current
+        joint plan, predicted vs measured throughput, pins, replan
+        triggers and the core-budget regime."""
+        with self._mu:
+            plan = self._plan
+            # Measured side of predicted-vs-measured: the host
+            # substrate's delivered window-fetch EWMA at the depth run.
+            measured = 0.0
+            cell = self.samples.cell(
+                "window", 0, plan.depth or self.requested_depth)
+            if cell is not None:
+                measured = round(cell.ewma / 1e9, 3)
+            return {
+                "enabled": self.enabled,
+                "engaged": plan.engaged,
+                "plan": {"route": dict(plan.route),
+                         "lanes": dict(plan.lanes),
+                         "depth": plan.depth, "width": plan.width},
+                "pins": dict(plan.pins),
+                "predicted_gbps": dict(plan.predicted_gbps),
+                "measured_window_gbps": measured,
+                "replans": self.replans,
+                "reasons": list(self.reasons),
+                "no_core_headroom": self.no_core_headroom,
+                "cores": self.model.cores,
+                "peers": self.model.peers,
+            }

@@ -236,6 +236,9 @@ class DDStore:
         self._advertised = None
         self._endpoints = None
         self._generation = 0
+        # Peer-topology listeners (see add_peer_listener): the cost-model
+        # scheduler replans when elastic recovery swaps an endpoint.
+        self._peer_listeners = []
         if backend == "local":
             gid = self.group.broadcast(uuid.uuid4().hex)
             self._gid = gid
@@ -715,6 +718,68 @@ class DDStore:
         into ``summary()["bytes_moved"]``'s lane view. ``[]`` for the
         local backend."""
         return self._native.lane_bytes(target)
+
+    # -- cost-model scheduler hooks ---------------------------------------
+
+    def sched_cells(self) -> list:
+        """Warm-window measurement cells (router + lane tuners) for the
+        cost-model scheduler (:mod:`ddstore_tpu.sched`): one dict per
+        (source, class, knob) cell with its EWMA bytes/s and clean
+        sample count. ``[]`` for the local backend."""
+        return self._native.sched_cells()
+
+    def sched_pin_route(self, cls: int, mode: int) -> None:
+        """Planner route pin (0 = CMA, 1 = TCP, -1 = release) for one
+        traffic class. No-op on the local backend (no router)."""
+        try:
+            self._native.sched_pin_route(cls, mode)
+        except DDStoreError:
+            pass  # non-TCP backend: nothing to pin
+
+    def sched_pin_lanes(self, cls: int, lanes: int) -> None:
+        """Planner lane-width pin (>= 1, or -1 to release) for one
+        traffic class. No-op on the local backend (no lanes)."""
+        try:
+            self._native.sched_pin_lanes(cls, lanes)
+        except DDStoreError:
+            pass
+
+    def set_async_width(self, n: int) -> None:
+        """Async admission width override (<= 0 restores the
+        ``DDSTORE_ASYNC_THREADS`` / core-ladder default)."""
+        self._native.set_async_width(n)
+
+    @property
+    def async_width(self) -> int:
+        """The async admission width currently in force."""
+        return self._native.async_width
+
+    def add_peer_listener(self, cb) -> None:
+        """Register a zero-arg callable invoked after any peer endpoint
+        changes (:meth:`update_peer` — elastic recovery re-pointing a
+        rank at a replacement process). The cost-model scheduler hooks
+        its topology-change replan here: the native tuners AND the
+        planner pins reset on a peer swap, so the plan must be rebuilt
+        from fresh samples."""
+        self._peer_listeners.append(cb)
+
+    def update_peer(self, target: int, host: str, port: int) -> None:
+        """Re-point one peer at a new endpoint (elastic recovery) and
+        notify peer listeners (scheduler replan). Native side closes the
+        stale connections, re-probes CMA, resets the adaptive tuners and
+        releases every planner pin."""
+        self._native.update_peer(target, host, port)
+        # Prune dead listeners first (a collected Scheduler advertises
+        # its death via the closure's `alive` attribute) — long-lived
+        # stores see one registration per discarded loader.
+        self._peer_listeners = [
+            cb for cb in self._peer_listeners
+            if getattr(cb, "alive", lambda: True)()]
+        for cb in list(self._peer_listeners):
+            try:
+                cb()
+            except Exception:
+                pass  # observability hook; never fails recovery
 
     @property
     def rank(self) -> int:

@@ -178,6 +178,10 @@ class PipelineMetrics:
         # bandwidth (bounded: one entry per window, windows are O(epoch
         # batches / W)).
         self._ra_fetch_samples: List[Tuple[int, float]] = []
+        # Cost-model scheduler snapshot source (Scheduler.snapshot):
+        # summary()["sched"] is how a bench record explains WHY each
+        # transport knob was set this epoch.
+        self._sched_source: Optional[Callable[[], Dict]] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -240,6 +244,16 @@ class PipelineMetrics:
         with self._fault_mu:
             out.update(self._fault_events)
         return out
+
+    def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
+            -> None:
+        """Attach a zero-arg callable returning the cost-model
+        scheduler's state (``Scheduler.snapshot``): the joint plan
+        (route/lanes/depth/width per class), its predicted vs measured
+        throughput, the user pins and the replan triggers. Reported
+        live in ``summary()["sched"]`` — the loader wires its scheduler
+        in automatically."""
+        self._sched_source = source
 
     def set_lane_source(self,
                         source: Optional[Callable[[], List[int]]]) -> None:
@@ -426,4 +440,12 @@ class PipelineMetrics:
         # any degradation event fired.
         if self._fault_begin is not None or any(faults.values()):
             out["faults"] = faults
+        if self._sched_source is not None:
+            # Live (not epoch-frozen): the plan is a current-state view,
+            # and a disabled scheduler's {"enabled": False} is itself
+            # the A/B fact the sched bench reads.
+            try:
+                out["sched"] = dict(self._sched_source())
+            except Exception:
+                pass  # a torn-down store must not sink the summary
         return out
