@@ -854,6 +854,209 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
     return out
 
 
+_FAILOVER_WORKER = r"""
+import os, sys, threading, time
+sys.path.insert(0, os.environ["DDSTORE_BENCH_REPO"])
+import numpy as np
+from ddstore_tpu import DDStore, DDStoreError, FileGroup
+from ddstore_tpu.data import DistributedSampler, ShardedDataset
+from ddstore_tpu.data.loader import DeviceLoader
+
+rank = int(os.environ["DDSTORE_RANK"])
+world = int(os.environ["DDSTORE_WORLD"])
+victim = int(os.environ["DDSTORE_VICTIM"])
+rdv = os.environ["DDSTORE_RDV_DIR"]
+num = int(os.environ["DDSTORE_BENCH_NUM"])
+dim = int(os.environ["DDSTORE_BENCH_DIM"])
+batch = int(os.environ["DDSTORE_BENCH_BATCH"])
+rows = num // world
+
+g = FileGroup(rdv, rank, world)
+store = DDStore(g, backend="tcp")
+# Per-rank seeded shards: the driver reconstructs the global oracle
+# locally (identical shards would hide wrong-replica routing bugs).
+shard = np.random.default_rng(100 + rank).standard_normal(
+    (rows, dim)).astype(np.float32)
+# Collective registration (add + replicate barriers inside).
+ds = ShardedDataset(store, shard, pre_sharded=True)
+store.barrier()
+
+done = os.path.join(rdv, "DONE")
+if rank == victim:
+    print("VICTIM_READY", flush=True)
+    while True:  # "train" until the harness SIGKILLs us
+        time.sleep(0.02)
+if rank != 0:
+    # Survivor owners: serve shard + mirror until the driver finishes
+    # (no barriers after the kill — exit abruptly like a real teardown).
+    while not os.path.exists(done):
+        time.sleep(0.05)
+    os._exit(0)
+
+# Rank 0 drives: clean epoch -> mid-epoch SIGKILL -> failover epoch.
+oracle = np.concatenate([
+    np.random.default_rng(100 + r).standard_normal(
+        (rows, dim)).astype(np.float32) for r in range(world)])
+sampler = DistributedSampler(num, world=1, rank=0, seed=7)
+
+
+def epoch(pace_s=0.0, kill_after=None):
+    loader = DeviceLoader(ds, sampler, batch_size=batch, mesh=None,
+                          readahead_windows=2,
+                          readahead_window_batches=4)
+    out = []
+    for i, b in enumerate(loader):
+        out.append(b.copy())
+        if kill_after is not None and i == kill_after:
+            open(os.path.join(rdv, "KILLME"), "w").close()
+        if pace_s:
+            time.sleep(pace_s)
+    return out, loader
+
+ref, _ = epoch()
+it = iter(sampler)
+import itertools
+for b in ref:  # absolute correctness of the clean epoch
+    idx = np.fromiter(itertools.islice(it, batch), np.int64)
+    np.testing.assert_array_equal(b, oracle[idx])
+
+# Suspect-latency poller: KILLED carries the parent's wall time at
+# SIGKILL; latency = first suspected observation - that.
+latency = {}
+
+
+def poll():
+    killed = os.path.join(rdv, "KILLED")
+    while not os.path.exists(killed):
+        time.sleep(0.01)
+    t_kill = float(open(killed).read().strip())
+    while victim not in store.suspected_peers():
+        time.sleep(0.01)
+    latency["detect_s"] = time.time() - t_kill
+
+poller = threading.Thread(target=poll, daemon=True)
+poller.start()
+fo0 = store.failover_stats()
+fs0 = store.fault_stats()
+peer_lost = 0
+t0 = time.perf_counter()
+try:
+    chaos, loader = epoch(pace_s=0.03, kill_after=2)
+except DDStoreError as e:
+    peer_lost = 1
+    chaos, loader = [], None
+t_chaos = time.perf_counter() - t0
+# The poller observes suspicion on its own schedule; give it a bounded
+# window to land before reading the latency.
+poller.join(timeout=15)
+fo = store.failover_stats()
+fs = store.fault_stats()
+identical = len(chaos) == len(ref) and all(
+    np.array_equal(a, b) for a, b in zip(ref, chaos))
+detect_s = latency.get("detect_s", -1.0)
+summary = loader.metrics.summary() if loader is not None else {}
+result = {
+    "failover_epoch_identical": bool(identical),
+    "failover_peer_lost_raised": peer_lost,
+    "failover_giveups": fs["retry_giveups"] - fs0["retry_giveups"],
+    "failover_reads": fo["failover_reads"] - fo0["failover_reads"],
+    "failover_suspect_skips": fo["suspect_skips"] - fo0["suspect_skips"],
+    "failover_replica_giveups":
+        fo["replica_giveups"] - fo0["replica_giveups"],
+    "failover_detect_s": round(detect_s, 3),
+    "failover_epoch_s": round(t_chaos, 3),
+    "failover_summary_present": "failover" in summary,
+}
+hb_budget_s = (int(os.environ["DDSTORE_HEARTBEAT_MS"])
+               * int(os.environ["DDSTORE_HEARTBEAT_SUSPECT_N"])) / 1e3
+result["failover_ok"] = bool(
+    identical and peer_lost == 0
+    and result["failover_giveups"] == 0
+    and result["failover_replica_giveups"] == 0
+    and result["failover_reads"] > 0
+    # Detection must beat the data path's ladder by construction: the
+    # heartbeat budget (x10 CPU-noise margin, the house timing style)
+    # is far under one DDSTORE_OP_DEADLINE_S.
+    and 0 <= detect_s <= max(5.0, 10 * hb_budget_s))
+import json
+print("#FAILOVER# " + json.dumps(result), flush=True)
+open(done, "w").close()
+os._exit(0)
+"""
+
+
+def failover_bench(world=4, num=8192, dim=32, batch=64, victim=2):
+    """Chaos-kill A/B (ISSUE 7 acceptance): REAL FileGroup processes
+    with DDSTORE_REPLICATION=2 and the heartbeat detector on; a shard
+    owner is SIGKILLed mid-epoch (readahead windows in flight) and the
+    epoch must complete BYTE-IDENTICAL to the clean oracle with zero
+    retry give-ups and zero kErrPeerLost — every lost read transparently
+    served from the dead rank's replica — and the detection-to-failover
+    latency exported. CMA off: the dead rank's still-mapped /dev/shm
+    shard would serve reads until the liveness gate trips, hiding the
+    wire-path failover this phase certifies."""
+    import signal
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="ddstore_failover_")
+    env = dict(
+        os.environ,
+        DDSTORE_BENCH_REPO=os.path.dirname(os.path.abspath(__file__)),
+        DDSTORE_RDV_DIR=tmp,
+        DDSTORE_WORLD=str(world),
+        DDSTORE_VICTIM=str(victim),
+        DDSTORE_BENCH_NUM=str(num),
+        DDSTORE_BENCH_DIM=str(dim),
+        DDSTORE_BENCH_BATCH=str(batch),
+        DDSTORE_REPLICATION="2",
+        DDSTORE_HEARTBEAT_MS="50",
+        DDSTORE_HEARTBEAT_SUSPECT_N="2",
+        DDSTORE_CMA="0",
+        DDSTORE_READ_TIMEOUT_S="2",
+        DDSTORE_CONNECT_TIMEOUT_S="2",
+        DDSTORE_RETRY_MAX="4",
+        DDSTORE_RETRY_BASE_MS="20",
+        DDSTORE_OP_DEADLINE_S="30",
+        DDSTORE_BARRIER_TIMEOUT_S="30",
+        JAX_PLATFORMS="cpu",
+    )
+    logs = [os.path.join(tmp, f"r{r}.log") for r in range(world)]
+    procs = {}
+    try:
+        for r in range(world):
+            procs[r] = subprocess.Popen(
+                [sys.executable, "-c", _FAILOVER_WORKER],
+                env=dict(env, DDSTORE_RANK=str(r)),
+                stdout=open(logs[r], "ab"), stderr=subprocess.STDOUT)
+        killme = os.path.join(tmp, "KILLME")
+        deadline = time.monotonic() + 180
+        while not os.path.exists(killme):
+            if procs[0].poll() is not None or \
+                    time.monotonic() > deadline:
+                raise RuntimeError(
+                    "failover driver never reached the kill point: " +
+                    open(logs[0], "rb").read().decode(
+                        errors="replace")[-2000:])
+            time.sleep(0.05)
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        # The wall timestamp of the ACTUAL kill, for the
+        # detection-latency export (same clock base, same host).
+        with open(os.path.join(tmp, "KILLED"), "w") as f:
+            f.write(str(time.time()))
+        assert procs[0].wait(timeout=180) == 0, \
+            open(logs[0], "rb").read().decode(errors="replace")[-2000:]
+        out = open(logs[0], "rb").read().decode(errors="replace")
+        line = next(l for l in out.splitlines()[::-1]
+                    if l.startswith("#FAILOVER# "))
+        return json.loads(line[len("#FAILOVER# "):])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+
 def lanes_bench(world=4, num=16384, dim=256, batch=256, nlanes=4):
     """Lane A/B (ISSUE 5 acceptance): a 4-owner ThreadGroup TCP store
     with CMA off runs the SAME workload twice — ``DDSTORE_TCP_LANES=1``
@@ -2128,6 +2331,20 @@ def _phase_chaos():
     return o
 
 
+def _phase_failover():
+    o = failover_bench()
+    print(f"# failover (R=2, owner SIGKILLed mid-epoch): epoch "
+          f"{'byte-identical' if o.get('failover_epoch_identical') else 'DIVERGED'}, "
+          f"{o.get('failover_reads', 0)} reads served from replicas "
+          f"({o.get('failover_suspect_skips', 0)} detector "
+          f"short-circuits), {o.get('failover_giveups', 0)} give-ups, "
+          f"{o.get('failover_peer_lost_raised', 0)} kErrPeerLost, "
+          f"suspected in {o.get('failover_detect_s', -1):.2f}s -> "
+          f"{'OK' if o.get('failover_ok') else 'NOT OK'}",
+          file=sys.stderr)
+    return o
+
+
 def _phase_devicefetch():
     # CPU smoke runs get the 8-device virtual mesh the tests use (a real
     # accelerator run keeps its actual local devices). Safe here: this
@@ -2176,7 +2393,7 @@ _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("numerics", _phase_numerics), ("lm", _phase_lm),
            ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong),
            ("ppsched", _phase_ppsched), ("chaos", _phase_chaos),
-           ("soak", _phase_soak))
+           ("failover", _phase_failover), ("soak", _phase_soak))
 
 
 def _kill_group(proc):
@@ -2261,6 +2478,10 @@ def main():
     # device phase's budget.
     chaos_timeout = float(os.environ.get(
         "DDSTORE_CHAOS_PHASE_TIMEOUT_S", 300))
+    # The failover chaos-kill phase runs 4 real processes + a SIGKILL +
+    # bounded detection waits; same own-cap pattern.
+    failover_timeout = float(os.environ.get(
+        "DDSTORE_FAILOVER_PHASE_TIMEOUT_S", 300))
     # The lanes A/B runs three full store lifetimes (1-lane, N-lane,
     # autotuned) over the wire path; its own cap (soak/ppsched/chaos
     # pattern) keeps a slow run from eating a device phase's budget.
@@ -2293,7 +2514,7 @@ def main():
     # exempt).
     device_phases = {n for n, _ in _PHASES
                      if n not in ("local", "tcp", "readahead", "lanes",
-                                  "sched", "chaos", "soak")}
+                                  "sched", "chaos", "failover", "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -2399,6 +2620,7 @@ def main():
             phase_timeout = {"soak": soak_timeout,
                              "ppsched": ppsched_timeout,
                              "chaos": chaos_timeout,
+                             "failover": failover_timeout,
                              "lanes": lanes_timeout,
                              "sched": sched_timeout}.get(name, timeout)
             try:

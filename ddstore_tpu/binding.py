@@ -129,6 +129,26 @@ def _load() -> ctypes.CDLL:
     lib.dds_set_async_width.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dds_async_width.restype = ctypes.c_int
     lib.dds_async_width.argtypes = [ctypes.c_void_p]
+    lib.dds_replication.restype = ctypes.c_int
+    lib.dds_replication.argtypes = [ctypes.c_void_p]
+    lib.dds_replicate.restype = ctypes.c_int
+    lib.dds_replicate.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dds_refresh_mirrors.restype = ctypes.c_int
+    lib.dds_refresh_mirrors.argtypes = [ctypes.c_void_p]
+    lib.dds_replica_set.restype = ctypes.c_int
+    lib.dds_replica_set.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.POINTER(ctypes.c_int),
+                                    ctypes.c_int]
+    lib.dds_health_state.restype = ctypes.c_int
+    lib.dds_health_state.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int]
+    lib.dds_heartbeat_configure.restype = ctypes.c_int
+    lib.dds_heartbeat_configure.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_long, ctypes.c_int]
+    lib.dds_mark_suspect.restype = ctypes.c_int
+    lib.dds_mark_suspect.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_int]
+    lib.dds_failover_stats.restype = ctypes.c_int
+    lib.dds_failover_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_fault_configure.restype = ctypes.c_int
     lib.dds_fault_configure.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                         ctypes.c_char_p]
@@ -218,6 +238,22 @@ LANE_STATE_KEYS = ("max_lanes", "active_lanes", "parked", "autotune",
 #: 1 = scatter; ``knob`` is the route (0 = cma, 1 = tcp) or the lane
 #: count the cell measures.
 SCHED_CELL_COLS = ("source", "cls", "knob", "ewma_bps", "n")
+
+
+#: dict keys of :meth:`NativeStore.failover_stats`, in native layout
+#: order (keep in sync with capi dds_failover_stats /
+#: Store::FailoverCounters). ``replication``, ``hb_active`` and
+#: ``suspected_now`` are GAUGES; everything else is monotone since
+#: store creation (PipelineMetrics diffs those per epoch).
+FAILOVER_STAT_KEYS = (
+    "replication", "failover_reads", "failover_runs", "failover_bytes",
+    "suspect_skips", "replica_giveups", "mirror_fills",
+    "mirror_refresh_skipped", "mirror_bytes", "hb_pings", "hb_failures",
+    "hb_suspects_raised", "hb_active", "suspected_now",
+)
+
+#: the gauge subset of :data:`FAILOVER_STAT_KEYS` (never delta'd).
+FAILOVER_GAUGE_KEYS = ("replication", "hb_active", "suspected_now")
 
 
 #: dict keys of :meth:`NativeStore.fault_stats`, in native layout order.
@@ -398,6 +434,72 @@ class NativeStore:
         """The admission width currently in force (override, env, or
         the 4/2/1 core-ladder default)."""
         return int(self._lib.dds_async_width(self._h))
+
+    # -- replication / failover / heartbeat -------------------------------
+
+    @property
+    def replication(self) -> int:
+        """Replication factor in force (``DDSTORE_REPLICATION`` clamped
+        to ``[1, world]``; 1 = off, exactly the pre-replication tree)."""
+        return int(self._lib.dds_replication(self._h))
+
+    def replicate(self, name: str) -> None:
+        """Pull/refresh this rank's mirrors of ``name`` (the shards of
+        the next R-1 ranks). Call AFTER the registration barrier."""
+        _check(self._lib.dds_replicate(self._h, name.encode()),
+               f"replicate({name})")
+
+    def refresh_mirrors(self) -> None:
+        """Re-pull every mirror this rank hosts, creating missing ones
+        (the elastic-recovery rebuild). Suspected/unreachable owners
+        are skipped, never fatal."""
+        _check(self._lib.dds_refresh_mirrors(self._h), "refresh_mirrors")
+
+    def replica_set(self, owner: int) -> list:
+        """Replica chain of ``owner``'s shard, primary first."""
+        cap = 64
+        arr = (ctypes.c_int * cap)()
+        n = self._lib.dds_replica_set(self._h, int(owner), arr, cap)
+        if n < 0:
+            raise DDStoreError(n, f"replica_set({owner})")
+        return list(arr)[:n]
+
+    def health_state(self) -> list:
+        """Per-peer suspicion flags (union of heartbeat verdicts and
+        data-path ladder give-ups), one bool per rank."""
+        cap = 1024
+        arr = (ctypes.c_int64 * cap)()
+        n = self._lib.dds_health_state(self._h, arr, cap)
+        if n < 0:
+            return []
+        return [bool(v) for v in list(arr)[:n]]
+
+    def heartbeat_configure(self, interval_ms: int,
+                            suspect_n: int = 0) -> None:
+        """(Re)start the heartbeat detector at ``interval_ms`` (<= 0
+        stops it; ``suspect_n`` <= 0 keeps the env/default threshold)."""
+        _check(self._lib.dds_heartbeat_configure(
+            self._h, int(interval_ms), int(suspect_n)),
+            "heartbeat_configure")
+
+    def mark_suspect(self, target: int, suspected: bool = True) -> None:
+        """Force one peer into (or out of) the suspect set — the
+        deterministic failover-routing hook tests use."""
+        _check(self._lib.dds_mark_suspect(self._h, int(target),
+                                          int(bool(suspected))),
+               f"mark_suspect({target})")
+
+    def failover_stats(self) -> dict:
+        """Replicated-read failover + heartbeat counters
+        (:data:`FAILOVER_STAT_KEYS`): reroutes served from replicas,
+        detector short-circuits (zero deadline burned), whole-replica-
+        set losses, mirror fill/refresh traffic, and the ping ledger.
+        Monotone except the :data:`FAILOVER_GAUGE_KEYS` gauges."""
+        arr = (ctypes.c_int64 * 16)()
+        _check(self._lib.dds_failover_stats(self._h, arr),
+               "failover_stats")
+        return dict(zip(FAILOVER_STAT_KEYS,
+                        list(arr)[:len(FAILOVER_STAT_KEYS)]))
 
     @property
     def barrier_seq(self) -> int:

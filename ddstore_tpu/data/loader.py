@@ -158,6 +158,12 @@ class DeviceLoader:
             # plan view: summary()["faults"] is how a chaos run proves
             # "faults absorbed, zero give-ups" from the record alone.
             self.metrics.set_fault_source(store.fault_stats)
+        if store is not None and hasattr(store, "failover_stats"):
+            # Replicated-read failover ledger: summary()["failover"]
+            # shows per-epoch reroutes/suspects/mirror traffic — an R>1
+            # epoch that lost a rank proves "replicas served, zero
+            # give-ups" from the record alone.
+            self.metrics.set_failover_source(store.failover_stats)
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether
@@ -486,6 +492,17 @@ class DeviceLoader:
         # subsequent store teardown can't race either.
         self.metrics.epoch_start()
         self._ra_degraded.clear()  # fresh epoch, fresh engine, fresh chance
+        # Liveness sweep at the epoch boundary: newly suspected peers
+        # fire the store's peer listeners (the scheduler replans its
+        # routes/lanes off the dead peer BEFORE this epoch's plan is
+        # applied below, instead of at the first deadline burn).
+        check_health = getattr(getattr(self.dataset, "store", None),
+                               "check_health", None)
+        if check_health is not None:
+            try:
+                check_health()
+            except Exception:
+                pass  # liveness polling must never fail an epoch
         if self.sched is not None:
             # Epoch-boundary replan BEFORE the engine is built: the
             # planned depth/width govern this epoch's ring and

@@ -433,6 +433,20 @@ class EpochReadahead:
                         self._error = e
                         self._cond.notify_all()
                     raise
+                # Liveness sweep first: with shard replication in force
+                # the window read normally fails over INSIDE the native
+                # layer and never reaches this branch, but a loss that
+                # did surface here should latch the suspect view before
+                # the refetch — its get_batch chunks then short-circuit
+                # the dead owner straight onto replicas (only the lost
+                # rows reroute; live owners' chunks read normally), so
+                # the window completes without another ladder burn.
+                check = getattr(self.store, "check_health", None)
+                if check is not None:
+                    try:
+                        check()
+                    except Exception:  # noqa: BLE001
+                        pass  # liveness polling must not mask the retry
                 # Degraded mode: the bulk window fetch failed after the
                 # native layer's own retries — retry ONCE at per-batch
                 # granularity before surfacing. The refetch shares the

@@ -244,6 +244,7 @@ def recover(store: DDStore, root: str,
     # Data-plane barrier proves end-to-end connectivity of the new world
     # before anyone resumes training.
     store.barrier()
+    _restore_replication(store)
 
 
 def rejoin(root: str, rank: int, world: int, ckpt_dir: str, *,
@@ -262,4 +263,21 @@ def rejoin(root: str, rank: int, world: int, ckpt_dir: str, *,
     store._generation = gen
     _commit_generation(root, gen)
     store.barrier()
+    _restore_replication(store)
     return store
+
+
+def _restore_replication(store: DDStore) -> None:
+    """Third phase of a recovery generation (collective, after the
+    connectivity barrier): rebuild the mirror chains for the new world.
+    Survivors re-pull the replacement's restored shard into their
+    mirrors (it may have rolled back to the checkpoint — a mirror
+    holding newer pre-crash bytes would serve rows the owner no longer
+    has); the replacement builds its whole chain from scratch. The
+    closing barrier makes the restored replication factor live before
+    anyone resumes training — a second death right after recovery is
+    already covered again."""
+    if store.replication <= 1:
+        return
+    store.refresh_mirrors()
+    store.barrier()

@@ -65,7 +65,85 @@ int dds_set_peers(dds_handle* h, const char** hosts, const int* ports, int n) {
 int dds_update_peer(dds_handle* h, int target, const char* host_csv,
                     int port) {
   if (!h || !h->tcp || !host_csv) return dds::kErrInvalidArg;
-  return h->tcp->UpdatePeer(target, host_csv, port);
+  int rc = h->tcp->UpdatePeer(target, host_csv, port);
+  // The replacement process gets a clean liveness slate: suspicion
+  // belonged to the dead process at the old endpoint.
+  if (rc == dds::kOk) h->store->ClearPeerSuspected(target);
+  return rc;
+}
+
+// -- replication / failover / heartbeat --------------------------------------
+
+// The replication factor in force (DDSTORE_REPLICATION clamped to
+// [1, world]; 1 = replication off, exactly the pre-replication tree).
+int dds_replication(dds_handle* h) {
+  return h ? h->store->replication() : dds::kErrInvalidArg;
+}
+
+// Pull/refresh this rank's mirrors of `name` (the shards of the next
+// R-1 ranks). The Python add() calls it after the registration barrier
+// (every owner's shard must exist before any holder pulls).
+int dds_replicate(dds_handle* h, const char* name) {
+  if (!h || !name) return dds::kErrInvalidArg;
+  return h->store->Replicate(name);
+}
+
+// Re-pull EVERY mirror this rank hosts, creating missing ones — the
+// elastic-recovery rebuild (survivors re-mirror the replacement's
+// restored shard; the replacement builds its chain from scratch).
+// Suspected/unreachable owners are skipped, never fatal.
+int dds_refresh_mirrors(dds_handle* h) {
+  if (!h) return dds::kErrInvalidArg;
+  h->store->RefreshMirrors();
+  return dds::kOk;
+}
+
+// Replica set of `owner`'s shard, primary first (chain placement).
+// Returns the count written into `out` (bounded by cap).
+int dds_replica_set(dds_handle* h, int owner, int* out, int cap) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  return h->store->ReplicaSet(owner, out, cap);
+}
+
+// Per-peer liveness view (union of heartbeat verdicts and data-path
+// ladder give-ups): writes min(world, cap) 0/1 suspicion flags,
+// returns the count written.
+int dds_health_state(dds_handle* h, int64_t* out, int cap) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  return h->store->HealthState(out, cap);
+}
+
+// Runtime heartbeat control: interval_ms > 0 (re)starts the detector
+// with that ping period (suspect_n <= 0 keeps the env/default
+// threshold); interval_ms <= 0 stops it. The suspect registry itself
+// survives a stop.
+int dds_heartbeat_configure(dds_handle* h, long interval_ms,
+                            int suspect_n) {
+  if (!h) return dds::kErrInvalidArg;
+  h->store->ConfigureHeartbeat(interval_ms, suspect_n);
+  return dds::kOk;
+}
+
+// Test/ops hook: force one peer into (or out of) the suspect set —
+// deterministic failover routing without killing anything.
+int dds_mark_suspect(dds_handle* h, int target, int suspected) {
+  if (!h) return dds::kErrInvalidArg;
+  if (suspected)
+    h->store->MarkPeerSuspected(target);
+  else
+    h->store->ClearPeerSuspected(target);
+  return dds::kOk;
+}
+
+// Failover/heartbeat observability snapshot. Layout (keep in sync with
+// binding.py FAILOVER_STAT_KEYS): [replication, failover_reads,
+// failover_runs, failover_bytes, suspect_skips, replica_giveups,
+// mirror_fills, mirror_refresh_skipped, mirror_bytes, hb_pings,
+// hb_failures, hb_suspects_raised, hb_active, suspected_now, 0, 0].
+int dds_failover_stats(dds_handle* h, int64_t out[16]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->FailoverCounters(out);
+  return dds::kOk;
 }
 
 int dds_routing_state(dds_handle* h, int cls, double* cma_bw,

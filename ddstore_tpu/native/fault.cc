@@ -215,7 +215,12 @@ int RetryTransientLoop(RetryStats& stats, int target,
                        const std::atomic<bool>* stop, uint64_t salt,
                        const std::function<int()>& attempt,
                        const std::function<void()>& on_retry,
-                       double deadline_override) {
+                       double deadline_override,
+                       const std::function<bool()>& suspect) {
+  // Detector short-circuit BEFORE the first attempt: a peer the
+  // heartbeat already declared dead gets no dial/read at all (no
+  // giveup counted — the budget was never engaged).
+  if (suspect && suspect()) return kErrPeerLost;
   int rc = attempt();
   if (rc == kOk) return rc;
   if (rc != kErrTransport) {
@@ -240,6 +245,10 @@ int RetryTransientLoop(RetryStats& stats, int target,
     // Teardown is not a verdict about the peer: abort with the plain
     // transient code, no giveup counted.
     if (stop && stop->load(std::memory_order_relaxed)) return kErrTransport;
+    // Detector verdict mid-ladder: stop burning the budget — the
+    // failover layer reroutes now. Not a giveup (the detector, not the
+    // deadline, classified the peer).
+    if (suspect && suspect()) return kErrPeerLost;
     if (att >= pol.max_retries ||
         std::chrono::steady_clock::now() >= deadline) {
       // Budget exhausted: reclassify as the bounded "owner is gone"

@@ -174,12 +174,19 @@ void FaultSleepMs(long ms, const std::atomic<bool>* stop);
 // replaces the policy's deadline_s — the per-store budget-sharing hook
 // above. Teardown (`stop` set) aborts with plain kErrTransport — a
 // self-inflicted shutdown must not bump giveups or read as a dead
-// peer.
+// peer. `suspect`, when set, is the heartbeat detector's verdict for
+// this target: once it returns true the ladder aborts IMMEDIATELY with
+// kErrPeerLost — WITHOUT counting a giveup (the budget was not burned;
+// the detector beat it) — so the replicated-read failover layer can
+// reroute in O(heartbeat) instead of O(deadline). Checked before the
+// first attempt and before every retry; never between, so an unset (or
+// never-true) callback leaves timing and counters bit-identical.
 int RetryTransientLoop(RetryStats& stats, int target,
                        const std::atomic<bool>* stop, uint64_t salt,
                        const std::function<int()>& attempt,
                        const std::function<void()>& on_retry = {},
-                       double deadline_override = 0.0);
+                       double deadline_override = 0.0,
+                       const std::function<bool()>& suspect = {});
 
 }  // namespace dds
 

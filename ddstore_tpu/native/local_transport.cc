@@ -31,8 +31,17 @@ void LocalGroup::Release(const std::string& gid) {
 
 void LocalGroup::Register(int rank, Store* store) {
   std::lock_guard<std::mutex> lock(mu_);
-  if (rank >= 0 && rank < world_) members_[rank] = store;
+  if (rank >= 0 && rank < world_) {
+    members_[rank] = store;
+    ever_registered_[rank] = true;
+  }
   cv_.notify_all();
+}
+
+bool LocalGroup::AliveOrPending(int rank) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (rank < 0 || rank >= world_) return false;
+  return members_[rank] != nullptr || !ever_registered_[rank];
 }
 
 void LocalGroup::Unregister(int rank) {
@@ -108,6 +117,11 @@ int LocalTransport::Read(int target, const std::string& name, int64_t offset,
   // ReadLocal holds the peer's read lock across the copy, so a concurrent
   // FreeVar on the peer cannot free the shard mid-read.
   return peer->ReadLocal(name, offset, nbytes, dst);
+}
+
+int64_t LocalTransport::ReadVarSeq(int target, const std::string& name) {
+  Store* peer = group_->member(target);
+  return peer ? peer->UpdateSeqOf(name) : -1;
 }
 
 int LocalTransport::ReadV(int target, const std::string& name,

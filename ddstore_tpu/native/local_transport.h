@@ -28,12 +28,19 @@ class LocalGroup {
   // Drop the group from the global registry (members keep their shared_ptr).
   static void Release(const std::string& gid);
 
-  explicit LocalGroup(int world) : world_(world), members_(world, nullptr) {}
+  explicit LocalGroup(int world)
+      : world_(world), members_(world, nullptr),
+        ever_registered_(world, false) {}
 
   int world() const { return world_; }
   void Register(int rank, Store* store);
   void Unregister(int rank);
   Store* member(int rank);
+  // Non-blocking liveness peek for the heartbeat detector: true while
+  // `rank` is registered OR has never registered yet (bootstrap is not
+  // death); false only after an Unregister — the in-process analogue
+  // of a closed listener.
+  bool AliveOrPending(int rank);
 
   // Counting barrier, per tag; every member must arrive with the same tag.
   int Barrier(int64_t tag);
@@ -47,6 +54,7 @@ class LocalGroup {
   std::mutex mu_;
   std::condition_variable cv_;
   std::vector<Store*> members_;
+  std::vector<bool> ever_registered_;
   std::map<int64_t, BarrierState> barriers_;
 };
 
@@ -64,6 +72,17 @@ class LocalTransport : public Transport {
            int64_t nbytes, void* dst) override;
   int ReadV(int target, const std::string& name, const ReadOp* ops,
             int64_t n) override;
+  // In-process liveness: a peer whose store was torn down (Unregister)
+  // is dead; one that has not constructed yet is pending, not dead. No
+  // fault-injector draw — control plane stays off the data path's
+  // deterministic schedule.
+  bool Ping(int target, long timeout_ms) override {
+    (void)timeout_ms;
+    return group_->AliveOrPending(target);
+  }
+  // Control-plane content-version probe (mirror refresh gate): direct
+  // registry read of the peer store, no fault-injector draw.
+  int64_t ReadVarSeq(int target, const std::string& name) override;
   int Barrier(int64_t tag) override { return group_->Barrier(tag); }
   int rank() const override { return rank_; }
   int world() const override { return group_->world(); }

@@ -63,15 +63,48 @@ const char* ErrorString(int code) {
   }
 }
 
+namespace {
+int ReplicationFromEnv(int world) {
+  long r = 1;
+  if (const char* env = std::getenv("DDSTORE_REPLICATION")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v >= 1) r = v;
+  }
+  if (r > world) r = world;  // R holders need R distinct ranks
+  return static_cast<int>(r);
+}
+}  // namespace
+
 Store::Store(std::unique_ptr<Transport> transport)
     : transport_(std::move(transport)),
       // Resolved once per store (the pre-admission-gate code read the
       // env once at pool creation): AsyncWidth() runs on the async
       // issue/completion hot path under async_mu_ and must not
       // getenv/strtol there.
-      async_default_(static_cast<int>(AsyncThreadsFromEnv())) {}
+      async_default_(static_cast<int>(AsyncThreadsFromEnv())) {
+  replication_ = ReplicationFromEnv(world());
+  health_.Init(rank(), world());
+  if (world() > 1) {
+    // Transports with an internal retry layer (TCP leaves) consult the
+    // suspect view between attempts (snapshotted once per leaf; the
+    // checks themselves are relaxed atomic loads). A never-marked view
+    // changes nothing — R=1 counters stay identical.
+    transport_->SetSuspectOracle(
+        [this](int t) { return PeerSuspected(t); });
+    const long interval = HeartbeatIntervalMsFromEnv(replication_);
+    if (interval > 0)
+      health_.Start(interval, HeartbeatSuspectNFromEnv(),
+                    [this, interval](int t) {
+                      return transport_->Ping(t, interval);
+                    });
+  }
+}
 
 Store::~Store() {
+  // The ping thread dials through the transport; stop it before any
+  // teardown the transport participates in.
+  health_.Stop();
   // In-flight async reads hold the shared lock and use the transport;
   // both must still exist while they finish.
   DrainAsync();
@@ -180,6 +213,7 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
   transport_->UnpublishVar(name);
   std::memcpy(v.base + row_offset * v.row_bytes(), buf,
               nrows * v.row_bytes());
+  ++v.update_seq;  // mirror holders re-pull at their next epoch fence
   transport_->PublishVar(name, v.base, v.shard_bytes());
   return kOk;
 }
@@ -201,11 +235,30 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
   int64_t offset = (start - shard_begin) * v.row_bytes();
   int64_t nbytes = count * v.row_bytes();
   if (target == rank()) return ReadLocal(name, offset, nbytes, dst);
-  return RetryTransient(
-      [&]() {
-        return transport_->Read(target, name, offset, nbytes, dst);
-      },
-      target);
+  if (replication_ <= 1)
+    return RetryTransient(
+        [&]() {
+          return transport_->Read(target, name, offset, nbytes, dst);
+        },
+        target);
+  // Replicated single-peer read: same failover contract as the batched
+  // paths (suspect short-circuit, ladder verdict -> replica chain,
+  // kErrPeerLost only when every holder is gone) but without the
+  // batched plan's per-call map — the healthy-primary common case is
+  // one direct retried read, exactly the R=1 fast path.
+  if (!PeerSuspected(target)) {
+    int rc = RetryTransient(
+        [&]() {
+          return transport_->Read(target, name, offset, nbytes, dst);
+        },
+        target);
+    if (rc != kErrPeerLost) return rc;
+    MarkPeerSuspected(target);
+  } else {
+    failover_.suspect_skips.fetch_add(1, std::memory_order_relaxed);
+  }
+  std::vector<ReadOp> ops(1, ReadOp{offset, nbytes, dst});
+  return ReadViaReplica(name, target, ops);
 }
 
 namespace {
@@ -375,23 +428,15 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
     }
   }
   if (!by_peer.empty()) {
-    std::vector<PeerReadV> reqs;
-    reqs.reserve(by_peer.size());
-    for (auto& kv : by_peer)
-      reqs.push_back(PeerReadV{kv.first, kv.second.data(),
-                               static_cast<int64_t>(kv.second.size())});
     // Transient failures are retried (store-level for transports without
-    // internal retry; the TCP transport retries per leaf). Retries are
+    // internal retry; the TCP transport retries per leaf); with
+    // replication > 1 a peer whose budget exhausts (or whom the
+    // heartbeat detector already declared dead) has its runs replanned
+    // onto its replica set inside RemoteRead. Retries/failovers are
     // idempotent: every op rewrites its own dst/scratch span. Fatal
     // errors return here — the scratch block and any launched local
     // task are released on every path (unique_ptr + the Wait below).
-    const int target = reqs.size() == 1 ? reqs[0].target : -1;
-    int rc = RetryTransient(
-        [&]() {
-          return transport_->ReadVMulti(name, reqs.data(),
-                                        static_cast<int64_t>(reqs.size()));
-        },
-        target);
+    int rc = RemoteRead(name, by_peer);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
       return rc;
@@ -432,10 +477,328 @@ int Store::RetryTransient(const std::function<int()>& call, int target) {
   // (endpoint table not set), not a retryable transient. Avoids
   // multiplying the two layers' budgets.
   if (transport_->RetriesInternally()) return call();
+  // The suspect hook engages only once failover could act on the
+  // verdict (replication/heartbeat in force); the default store stays
+  // bit-identical, counters included.
+  std::function<bool()> suspect;
+  if (target >= 0 && (replication_ > 1 || health_.running()))
+    suspect = [this, target]() { return PeerSuspected(target); };
   return RetryTransientLoop(
       retry_, target, /*stop=*/nullptr,
       static_cast<uint64_t>(target + 1), call, /*on_retry=*/{},
-      retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9);
+      retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9, suspect);
+}
+
+// -- shard replication + transparent read failover ---------------------------
+
+std::string Store::MirrorVarName(const std::string& name, int owner) {
+  // \x01 cannot appear in a user variable name that came through the
+  // Python layer (and '/'-suffixed ragged parts keep their own names),
+  // so mirror names can never collide with primaries.
+  return std::string("\x01mirror\x01") + std::to_string(owner) +
+         "\x01" + name;
+}
+
+int Store::ReplicaSet(int owner, int* out, int cap) const {
+  if (!out || owner < 0 || owner >= world()) return kErrInvalidArg;
+  int n = 0;
+  for (int k = 0; k < replication_ && n < cap; ++k)
+    out[n++] = (owner - k + world()) % world();
+  return n;
+}
+
+int Store::FillMirror(const std::string& name, int owner,
+                      const VarInfo& v, int64_t src_seq) {
+  const std::string mname = MirrorVarName(name, owner);
+  const int64_t shard_begin = owner == 0 ? 0 : v.cum[owner - 1];
+  const int64_t nrows = v.cum[owner] - shard_begin;
+  const int64_t rb = v.row_bytes();
+  const int64_t bytes = nrows * rb;
+  {
+    // (Re)register the mirror variable. Its cumulative table is
+    // local-only ({nrows}): mirrors are never addressed by global row —
+    // every consumer reads them by byte offset within the mirrored
+    // shard, exactly like the primary's serving paths do.
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    auto it = vars_.find(mname);
+    if (it == vars_.end()) {
+      VarInfo m;
+      m.name = mname;
+      m.disp = v.disp;
+      m.itemsize = v.itemsize;
+      m.nrows = nrows;
+      m.cum.assign(1, nrows);
+      m.base = static_cast<char*>(transport_->AllocShard(mname, bytes));
+      if (!m.base) return kErrNoMem;
+      m.owned = true;
+      const VarInfo& placed =
+          vars_.emplace(mname, std::move(m)).first->second;
+      transport_->PublishVar(mname, placed.base, placed.shard_bytes());
+    } else if (it->second.shard_bytes() != bytes ||
+               it->second.disp != v.disp ||
+               it->second.itemsize != v.itemsize) {
+      return kErrShapeMismatch;  // stale mirror of a re-registered var
+    }
+  }
+  if (bytes == 0 || owner == rank()) return kOk;
+  // Pull in bounded ROW-ALIGNED chunks: transport-read into scratch
+  // OUTSIDE the lock (a whole-shard read may take a while; readers
+  // must not stall behind it), then copy into the mirror under the
+  // exclusive lock. Row alignment means each locked copy publishes
+  // whole rows, so a concurrent failover reader sees any row either
+  // old or new — a row straddling a chunk boundary would otherwise be
+  // observable half-refreshed between two chunk copies.
+  constexpr int64_t kFillChunk = 8 << 20;
+  const int64_t chunk =
+      rb >= kFillChunk ? rb : kFillChunk - (kFillChunk % rb);
+  std::unique_ptr<char[]> scratch(
+      new char[static_cast<size_t>(bytes < chunk ? bytes : chunk)]);
+  for (int64_t off = 0; off < bytes; off += chunk) {
+    const int64_t take = bytes - off < chunk ? bytes - off : chunk;
+    int rc = RetryTransient(
+        [&]() {
+          return transport_->Read(owner, name, off, take, scratch.get());
+        },
+        owner);
+    if (rc != kOk) return rc;
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    auto it = vars_.find(mname);
+    if (it == vars_.end()) return kErrNotFound;  // freed mid-fill
+    std::memcpy(it->second.base + off, scratch.get(),
+                static_cast<size_t>(take));
+  }
+  {
+    // Record the content version pulled (read BEFORE the pull: a
+    // concurrent Update lands as "newer than recorded" and re-pulls at
+    // the next fence — the safe direction).
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    auto it = vars_.find(mname);
+    if (it != vars_.end()) it->second.mirror_src_seq = src_seq;
+  }
+  failover_.mirror_fills.fetch_add(1, std::memory_order_relaxed);
+  failover_.mirror_bytes.fetch_add(bytes, std::memory_order_relaxed);
+  return kOk;
+}
+
+int Store::Replicate(const std::string& name) {
+  if (replication_ <= 1 || world() <= 1) return kOk;
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  for (int k = 1; k < replication_; ++k) {
+    const int owner = (rank() + k) % world();
+    if (owner == rank()) break;
+    int rc = FillMirror(name, owner, v,
+                        transport_->ReadVarSeq(owner, name));
+    if (rc != kOk) return rc;
+  }
+  return kOk;
+}
+
+void Store::RefreshMirrors(bool force) {
+  if (replication_ <= 1 || world() <= 1) return;
+  // Snapshot the primary registry first (FillMirror takes the
+  // exclusive lock itself).
+  std::vector<std::pair<std::string, VarInfo>> prim;
+  {
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    for (const auto& kv : vars_)
+      if (kv.first.empty() || kv.first[0] != '\x01')
+        prim.emplace_back(kv.first, kv.second);
+  }
+  for (const auto& nv : prim) {
+    for (int k = 1; k < replication_; ++k) {
+      const int owner = (rank() + k) % world();
+      if (owner == rank()) break;
+      if (PeerSuspected(owner)) {
+        // The mirror keeps its last good bytes — that copy is exactly
+        // what failover is serving for this owner right now.
+        failover_.mirror_refresh_skipped.fetch_add(
+            1, std::memory_order_relaxed);
+        continue;
+      }
+      // Content-version gate (epoch-fence refreshes only): one tiny
+      // control read per mirror instead of a whole-shard pull when the
+      // owner has not Update()d since the last pull. Forced refreshes
+      // (elastic rebuild) skip the gate — a replacement's restored
+      // shard may have ROLLED BACK to its checkpoint at the same seq.
+      const int64_t seq = transport_->ReadVarSeq(owner, nv.first);
+      if (!force && seq >= 0) {
+        bool fresh = false;
+        {
+          std::shared_lock<std::shared_mutex> lock(mu_);
+          auto mit = vars_.find(MirrorVarName(nv.first, owner));
+          fresh = mit != vars_.end() &&
+                  mit->second.mirror_src_seq == seq;
+        }
+        if (fresh) continue;
+      }
+      if (FillMirror(nv.first, owner, nv.second, seq) != kOk)
+        failover_.mirror_refresh_skipped.fetch_add(
+            1, std::memory_order_relaxed);
+    }
+  }
+}
+
+int64_t Store::UpdateSeqOf(const std::string& name) const {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  return it == vars_.end() ? -1 : it->second.update_seq;
+}
+
+int Store::LastFailedPeer() const {
+  if (transport_->RetriesInternally()) return transport_->last_failed_peer();
+  int64_t out[7];
+  retry_.Snapshot(out);
+  return static_cast<int>(out[6]);
+}
+
+bool Store::PeerSuspected(int target) const {
+  return health_.Suspected(target);
+}
+
+void Store::MarkPeerSuspected(int target) { health_.MarkSuspected(target); }
+
+void Store::ClearPeerSuspected(int target) { health_.ResetPeer(target); }
+
+int Store::HealthState(int64_t* out, int cap) const {
+  return health_.SuspectFlags(out, cap);
+}
+
+void Store::ConfigureHeartbeat(long interval_ms, int suspect_n) {
+  if (interval_ms <= 0 || world() <= 1) {
+    health_.Stop();
+    return;
+  }
+  const int n = suspect_n > 0 ? suspect_n : HeartbeatSuspectNFromEnv();
+  health_.Start(interval_ms, n, [this, interval_ms](int t) {
+    return transport_->Ping(t, interval_ms);
+  });
+}
+
+void Store::FailoverCounters(int64_t out[16]) const {
+  for (int i = 0; i < 16; ++i) out[i] = 0;
+  out[0] = replication_;
+  out[1] = failover_.reads.load(std::memory_order_relaxed);
+  out[2] = failover_.runs.load(std::memory_order_relaxed);
+  out[3] = failover_.bytes.load(std::memory_order_relaxed);
+  out[4] = failover_.suspect_skips.load(std::memory_order_relaxed);
+  out[5] = failover_.replica_giveups.load(std::memory_order_relaxed);
+  out[6] = failover_.mirror_fills.load(std::memory_order_relaxed);
+  out[7] = failover_.mirror_refresh_skipped.load(std::memory_order_relaxed);
+  out[8] = failover_.mirror_bytes.load(std::memory_order_relaxed);
+  int64_t hb[4];
+  health_.Counters(hb);
+  out[9] = hb[0];
+  out[10] = hb[1];
+  out[11] = hb[2];
+  out[12] = hb[3];
+  out[13] = health_.SuspectedCount();
+}
+
+int Store::ReadViaReplica(const std::string& name, int owner,
+                          const std::vector<ReadOp>& ops) {
+  int64_t bytes = 0;
+  for (const ReadOp& op : ops) bytes += op.nbytes;
+  for (int k = 1; k < replication_; ++k) {
+    const int h = (owner - k + world()) % world();
+    if (h == owner) break;
+    const std::string mname = MirrorVarName(name, owner);
+    int rc;
+    if (h == rank()) {
+      rc = ReadLocalV(mname, ops.data(),
+                      static_cast<int64_t>(ops.size()));
+      if (rc == kErrNotFound) continue;  // mirror never built here
+    } else {
+      if (PeerSuspected(h)) continue;
+      PeerReadV rq{h, ops.data(), static_cast<int64_t>(ops.size())};
+      rc = RetryTransient(
+          [&]() { return transport_->ReadVMulti(mname, &rq, 1); }, h);
+      if (rc == kErrPeerLost) {
+        MarkPeerSuspected(h);
+        continue;
+      }
+      if (rc == kErrNotFound) continue;  // holder carries no mirror
+    }
+    if (rc == kOk) {
+      failover_.reads.fetch_add(1, std::memory_order_relaxed);
+      failover_.runs.fetch_add(static_cast<int64_t>(ops.size()),
+                               std::memory_order_relaxed);
+      failover_.bytes.fetch_add(bytes, std::memory_order_relaxed);
+      return kOk;
+    }
+    return rc;  // fatal (out-of-range against the mirror, ...)
+  }
+  // Primary AND every mirror holder gone: the bounded "rows truly
+  // lost" signal — elastic.recover is the next rung.
+  failover_.replica_giveups.fetch_add(1, std::memory_order_relaxed);
+  return kErrPeerLost;
+}
+
+int Store::RemoteRead(const std::string& name,
+                      const std::map<int, std::vector<ReadOp>>& by_peer) {
+  if (by_peer.empty()) return kOk;
+  if (replication_ <= 1) {
+    // Exactly the pre-replication remote leg: one retried ReadVMulti,
+    // kErrPeerLost surfacing unchanged (byte- and counter-identical).
+    std::vector<PeerReadV> reqs;
+    reqs.reserve(by_peer.size());
+    for (const auto& kv : by_peer)
+      reqs.push_back(PeerReadV{kv.first, kv.second.data(),
+                               static_cast<int64_t>(kv.second.size())});
+    const int target = reqs.size() == 1 ? reqs[0].target : -1;
+    return RetryTransient(
+        [&]() {
+          return transport_->ReadVMulti(name, reqs.data(),
+                                        static_cast<int64_t>(reqs.size()));
+        },
+        target);
+  }
+  // Failover plan: suspected peers route straight to their replicas
+  // (zero deadline burn); the rest issue normally; a kErrPeerLost
+  // verdict names the dead peer, marks it suspected, and the loop
+  // replans — only ITS ops move to the replica chain, everything else
+  // re-reads idempotently. Bounded by world() iterations (each round
+  // permanently retires at least one peer into the suspect set).
+  std::map<int, std::vector<ReadOp>> pending(by_peer);
+  for (int round = 0; round <= world(); ++round) {
+    std::vector<PeerReadV> go;
+    for (auto& kv : pending) {
+      if (PeerSuspected(kv.first)) {
+        failover_.suspect_skips.fetch_add(1, std::memory_order_relaxed);
+        int rc = ReadViaReplica(name, kv.first, kv.second);
+        if (rc != kOk) return rc;
+      } else {
+        go.push_back(PeerReadV{kv.first, kv.second.data(),
+                               static_cast<int64_t>(kv.second.size())});
+      }
+    }
+    if (go.empty()) return kOk;
+    const int target = go.size() == 1 ? go[0].target : -1;
+    int rc = RetryTransient(
+        [&]() {
+          return transport_->ReadVMulti(name, go.data(),
+                                        static_cast<int64_t>(go.size()));
+        },
+        target);
+    if (rc == kOk) return kOk;
+    if (rc != kErrPeerLost) return rc;  // fatal data error / teardown
+    int dead = target >= 0 ? target : LastFailedPeer();
+    bool named = false;
+    for (const PeerReadV& g : go) named = named || g.target == dead;
+    // A stale/unset diagnostic cannot stall the plan: retire the first
+    // still-pending peer (idempotent re-reads make this safe; a live
+    // peer wrongly retired is served by its replica, and the heartbeat
+    // un-suspects it at the next successful ping).
+    if (!named) dead = go[0].target;
+    MarkPeerSuspected(dead);
+    std::map<int, std::vector<ReadOp>> next;
+    for (const PeerReadV& g : go)
+      next.emplace(g.target,
+                   std::vector<ReadOp>(g.ops, g.ops + g.n));
+    pending.swap(next);
+  }
+  failover_.replica_giveups.fetch_add(1, std::memory_order_relaxed);
+  return kErrPeerLost;
 }
 
 int Store::AsyncWidth() const {
@@ -577,18 +940,7 @@ int Store::ReadRuns(const std::string& name, char* dst,
     }
   }
   if (!by_peer.empty()) {
-    std::vector<PeerReadV> reqs;
-    reqs.reserve(by_peer.size());
-    for (auto& kv : by_peer)
-      reqs.push_back(PeerReadV{kv.first, kv.second.data(),
-                               static_cast<int64_t>(kv.second.size())});
-    const int target = reqs.size() == 1 ? reqs[0].target : -1;
-    int rc = RetryTransient(
-        [&]() {
-          return transport_->ReadVMulti(name, reqs.data(),
-                                        static_cast<int64_t>(reqs.size()));
-        },
-        target);
+    int rc = RemoteRead(name, by_peer);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
       return rc;
@@ -656,9 +1008,18 @@ int Store::EpochBegin() {
     fence_active_ = true;
     ++epoch_tag_;
   }
+  int rc = kOk;
   if (epoch_collective_ && world() > 1)
-    return transport_->Barrier((epoch_tag_ << 1) | 0);
-  return kOk;
+    rc = transport_->Barrier((epoch_tag_ << 1) | 0);
+  // Mirror refresh rides the epoch fence: Update()s applied since the
+  // last fence become failover-visible here (the paper's
+  // update/epoch_begin contract). Content-version-gated — a static
+  // dataset's fence costs one control read per mirror, not a
+  // whole-shard pull. Suspected owners are skipped — their mirror
+  // keeps the last good bytes — and refresh failures are counted,
+  // never fatal (a dying owner must not fail the fence).
+  if (rc == kOk && replication_ > 1) RefreshMirrors(/*force=*/false);
+  return rc;
 }
 
 int Store::EpochEnd() {
@@ -697,6 +1058,18 @@ int Store::FreeVar(const std::string& name) {
   transport_->UnpublishVar(name);
   if (it->second.owned) transport_->FreeShard(name, it->second.base);
   vars_.erase(it);
+  // Drop this rank's mirrors of the freed variable too (free() is
+  // collective at the Python layer, so every holder runs this).
+  if (replication_ > 1) {
+    for (int o = 0; o < world(); ++o) {
+      auto mit = vars_.find(MirrorVarName(name, o));
+      if (mit == vars_.end()) continue;
+      transport_->UnpublishVar(mit->first);
+      if (mit->second.owned)
+        transport_->FreeShard(mit->first, mit->second.base);
+      vars_.erase(mit);
+    }
+  }
   return kOk;
 }
 

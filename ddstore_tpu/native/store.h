@@ -32,6 +32,7 @@
 #include <vector>
 
 #include "fault.h"
+#include "health.h"
 
 namespace dds {
 
@@ -64,6 +65,14 @@ struct VarInfo {
   std::vector<int64_t> cum;
   char* base = nullptr;  // local shard memory
   bool owned = false;    // true if the store allocated (and must free) base
+  // Monotone content version: bumped by every Update() to the LOCAL
+  // shard. Mirror holders compare it (one tiny kOpVarSeq control read)
+  // before an epoch-fence refresh, so an unchanged shard costs no
+  // re-pull. On a MIRROR entry, `mirror_src_seq` instead records the
+  // owner's seq the mirror bytes were pulled at (-1 = unknown: always
+  // re-pull).
+  int64_t update_seq = 0;
+  int64_t mirror_src_seq = -1;
 
   int64_t row_bytes() const { return disp * itemsize; }
   int64_t total_rows() const { return cum.empty() ? 0 : cum.back(); }
@@ -102,6 +111,25 @@ struct PlanStats {
   int64_t scratch_runs = 0;   // runs staged through scratch (src-contiguous
                               // but dst-scattered)
   int64_t scratch_bytes = 0;  // bytes staged through scratch
+};
+
+// Replicated-read failover accounting. Monotone since store creation;
+// consumers diff snapshots for per-epoch views (PipelineMetrics wires
+// this in as summary()["failover"]).
+struct FailoverStats {
+  std::atomic<int64_t> reads{0};          // per-peer op lists rerouted
+  std::atomic<int64_t> runs{0};           // ops those lists carried
+  std::atomic<int64_t> bytes{0};          // bytes served from replicas
+  std::atomic<int64_t> suspect_skips{0};  // reroutes decided by the
+  //                                         detector BEFORE any ladder
+  //                                         (zero deadline burned)
+  std::atomic<int64_t> replica_giveups{0};  // every holder gone ->
+  //                                           kErrPeerLost surfaced
+  std::atomic<int64_t> mirror_fills{0};     // mirrors (re)filled
+  std::atomic<int64_t> mirror_refresh_skipped{0};  // refresh skipped:
+  //                                           owner suspected/unreadable
+  //                                           (mirror keeps last bytes)
+  std::atomic<int64_t> mirror_bytes{0};     // bytes pulled into mirrors
 };
 
 class WorkerPool;
@@ -191,6 +219,46 @@ class Transport {
   // RetryTransientLoop calls. Default no-op for transports the
   // Store-level layer covers.
   virtual void SetRetryDeadline(double seconds) { (void)seconds; }
+
+  // -- control-plane liveness hooks ---------------------------------------
+
+  // One heartbeat probe of `target`, bounded by `timeout_ms`. MUST NOT
+  // ride the data path (no fault-injector draws — seeded chaos
+  // schedules stay identical with the detector on or off) and must not
+  // contend with data lanes (a lane mutex held across a long striped
+  // read would read as a dead peer). `true` when the peer answered OR
+  // when liveness is not yet decidable (endpoints not exchanged) — the
+  // detector must not raise suspects during bootstrap.
+  virtual bool Ping(int target, long timeout_ms) {
+    (void)target;
+    (void)timeout_ms;
+    return true;
+  }
+
+  // The most recent peer a retry layer failed against (-1 = none). The
+  // failover layer uses it to name the dead member of a multi-peer
+  // batched read (a self-retrying transport tracks its own leaf stats;
+  // others are covered by the Store-level layer's counter).
+  virtual int last_failed_peer() const { return -1; }
+
+  // Content-version probe of `target`'s shard of `name` (the mirror
+  // refresh's cheap "anything new?" check). -1 = unknown/unsupported —
+  // the caller must then refresh unconditionally (the safe default).
+  // Control plane: like Ping, never a fault-injector draw.
+  virtual int64_t ReadVarSeq(int target, const std::string& name) {
+    (void)target;
+    (void)name;
+    return -1;
+  }
+
+  // Install the store's suspect oracle: transports with an internal
+  // retry layer consult it between attempts so a ladder against a
+  // detector-declared-dead peer aborts in O(heartbeat), not
+  // O(deadline). Default no-op (the Store-level retry layer consults
+  // the oracle itself).
+  virtual void SetSuspectOracle(std::function<bool(int)> oracle) {
+    (void)oracle;
+  }
 
   // Collective tagged barrier across the group. Every rank must issue the
   // same serialized sequence of Barrier calls (matching is positional —
@@ -336,6 +404,67 @@ class Store {
   // The width currently admitting (override, env, or ladder default).
   int AsyncWidth() const;
 
+  // -- shard replication + transparent read failover ----------------------
+  //
+  // DDSTORE_REPLICATION=R (default 1 = exactly the pre-replication
+  // behavior, byte- and error-code-identical): each rank additionally
+  // hosts read-only MIRRORS of the next R-1 ranks' shards (chain
+  // placement), registered as hidden variables (MirrorVarName) and
+  // served through every existing path (local memcpy, CMA shm, TCP).
+  // Remote reads route to the primary owner; on transient-budget
+  // exhaustion or a heartbeat-detector verdict the failed peer's runs
+  // replan onto its replica set instead of raising kErrPeerLost — which
+  // now fires only when ALL R holders are gone. Mirrors fill at
+  // Replicate() (the Python add() calls it post-barrier) and refresh at
+  // EpochBegin (picking up Update()s); a suspected owner's refresh is
+  // skipped so the mirror keeps its last good bytes — exactly the copy
+  // failover needs.
+
+  // The replication factor in force (env, clamped to [1, world]).
+  int replication() const { return replication_; }
+  // Hidden registry name of this rank's mirror of `owner`'s shard of
+  // `name` (exposed for tests).
+  static std::string MirrorVarName(const std::string& name, int owner);
+  // Replica set of `owner`'s shard, primary first: out[k] =
+  // (owner - k) mod world for k in [0, R). Exposed for tests/Python.
+  int ReplicaSet(int owner, int* out, int cap) const;
+  // Pull/refresh this rank's mirrors of `name` (the shards of ranks
+  // rank+1 .. rank+R-1). Collective discipline is the caller's: every
+  // owner's shard must be registered before any holder pulls.
+  int Replicate(const std::string& name);
+  // Re-pull the mirrors this rank hosts, creating missing ones.
+  // `force` re-pulls unconditionally (the elastic-recovery rebuild —
+  // a replacement's restored shard may have ROLLED BACK to its
+  // checkpoint at the same content version); the EpochBegin refresh
+  // passes false and skips owners whose update_seq matches the last
+  // pull (a static dataset's fence costs one tiny control read per
+  // mirror, not a whole-shard pull). Suspected/unreachable owners are
+  // skipped either way, never fatal.
+  void RefreshMirrors(bool force = true);
+
+  // Content version of the LOCAL shard (served to mirror holders over
+  // the transport's kOpVarSeq control op). -1 if unknown.
+  int64_t UpdateSeqOf(const std::string& name) const;
+
+  // Peer-liveness view: the union of heartbeat verdicts and data-path
+  // ladder give-ups. ClearPeerSuspected is the elastic-recovery hook
+  // (the replacement process at this rank gets a clean slate).
+  bool PeerSuspected(int target) const;
+  void MarkPeerSuspected(int target);
+  void ClearPeerSuspected(int target);
+  // Writes min(world, cap) 0/1 suspicion flags; returns count written.
+  int HealthState(int64_t* out, int cap) const;
+  // Start/stop the heartbeat thread at runtime (interval_ms <= 0
+  // stops; suspect_n <= 0 keeps the env/default).
+  void ConfigureHeartbeat(long interval_ms, int suspect_n);
+
+  // Failover/heartbeat observability. Layout (keep in sync with
+  // binding.py FAILOVER_STAT_KEYS): [replication, failover_reads,
+  // failover_runs, failover_bytes, suspect_skips, replica_giveups,
+  // mirror_fills, mirror_refresh_skipped, mirror_bytes, hb_pings,
+  // hb_failures, hb_suspects_raised, hb_active, suspected_now].
+  void FailoverCounters(int64_t out[16]) const;
+
   // Metadata query: total rows across all ranks (reference `query`,
   // src/ddstore.cxx:46-49) plus shape info.
   int Query(const std::string& name, int64_t* total_rows, int64_t* disp,
@@ -412,6 +541,32 @@ class Store {
   // last_peer diagnostic; -1 = multi-peer/unknown.
   int RetryTransient(const std::function<int()>& call, int target);
 
+  // The remote leg of GetBatch/ReadRuns: with replication off this IS
+  // the old single retried ReadVMulti; with R > 1 it partitions out
+  // suspected peers (replica-routed with zero deadline burn), issues
+  // the rest, and on a kErrPeerLost verdict marks the named peer
+  // suspected and replans ITS ops onto the replica set — iterating
+  // until everything landed or a row's whole replica set is gone.
+  int RemoteRead(const std::string& name,
+                 const std::map<int, std::vector<ReadOp>>& by_peer);
+  // Serve `owner`'s ops from its replica chain (local mirror memcpy or
+  // a remote read of the holder's mirror variable). kErrPeerLost when
+  // every holder is gone or mirrorless.
+  int ReadViaReplica(const std::string& name, int owner,
+                     const std::vector<ReadOp>& ops);
+  // (Re)register + pull this rank's mirror of `owner`'s shard of
+  // `name`, recording `src_seq` as the content version pulled.
+  // Chunked row-aligned: transport-read into scratch, then copy under
+  // the exclusive lock (concurrent failover readers see every row
+  // either old or new — never torn, never a data race).
+  int FillMirror(const std::string& name, int owner, const VarInfo& v,
+                 int64_t src_seq);
+  // The peer the most recent retry-layer failure named (-1 unknown).
+  int LastFailedPeer() const;
+
+  int replication_ = 1;    // env, clamped to [1, world] at construction
+  FailoverStats failover_;
+
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
   mutable std::shared_mutex mu_;
@@ -466,6 +621,11 @@ class Store {
   int async_default_ = 2;  // env/ladder default, resolved at construction
   int async_running_ = 0;  // reads admitted to the pool (async_mu_)
   std::deque<std::function<void()>> async_deferred_;  // awaiting a slot
+
+  // Heartbeat failure detector + suspect registry. Declared LAST so it
+  // is destroyed FIRST (reverse member order): the ping thread must be
+  // joined before the transport it pings goes away.
+  HealthMonitor health_;
 };
 
 }  // namespace dds

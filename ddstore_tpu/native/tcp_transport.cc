@@ -1,9 +1,11 @@
 #include "tcp_transport.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <sys/uio.h>
@@ -23,7 +25,14 @@ namespace {
 
 constexpr uint32_t kMagic = 0xDD57EAD0;
 enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
-                     kOpCmaInfo = 4 };
+                     kOpCmaInfo = 4,
+                     // Control-plane ops: heartbeat probe (bare ok
+                     // WireResp) and shard content-version query (seq
+                     // in resp.nbytes). Deliberately OUTSIDE the fault
+                     // injector's op gate below — control frames must
+                     // not consume data-path draws, or seeded chaos
+                     // schedules would shift with the detector on.
+                     kOpPing = 5, kOpVarSeq = 6 };
 
 #pragma pack(push, 1)
 struct WireReq {
@@ -425,8 +434,10 @@ TcpTransport::TcpTransport(int rank, int world, int port)
     }
   }
   peers_.resize(world_);
+  ping_conns_.resize(world_);
   for (int i = 0; i < world_; ++i) {
     peers_[i] = std::make_unique<Peer>();
+    ping_conns_[i] = std::make_unique<PingConn>();
     for (long c = 0; c < nconn; ++c) {
       auto conn = std::make_unique<Conn>();
       conn->idx = static_cast<int>(c);
@@ -491,6 +502,8 @@ TcpTransport::~TcpTransport() {
     for (auto& c : p->conns)
       if (c->fd >= 0) ::close(c->fd);
   }
+  for (auto& pc : ping_conns_)
+    if (pc && pc->fd >= 0) ::close(pc->fd);
 }
 
 int TcpTransport::SetPeers(const std::vector<std::string>& hosts,
@@ -502,6 +515,11 @@ int TcpTransport::SetPeers(const std::vector<std::string>& hosts,
     peers_[i]->hosts = SplitCsv(hosts[i]);
     if (peers_[i]->hosts.empty()) return kErrInvalidArg;
     peers_[i]->port = ports[i];
+    PingConn& pc = *ping_conns_[i];
+    std::lock_guard<std::mutex> lock(pc.mu);
+    pc.hosts = peers_[i]->hosts;
+    pc.next_host = 0;
+    pc.port = ports[i];
   }
   return kOk;
 }
@@ -594,6 +612,19 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
   // peer-change replan re-applies a fresh plan.
   for (std::atomic<int>& p : route_pin_) p.store(-1);
   for (std::atomic<int>& p : lane_pin_) p.store(-1);
+  // The heartbeat's dedicated connection belonged to the dead process;
+  // the next ping redials the replacement at its endpoint.
+  {
+    PingConn& pc = *ping_conns_[target];
+    std::lock_guard<std::mutex> lock(pc.mu);
+    if (pc.fd >= 0) {
+      ::close(pc.fd);
+      pc.fd = -1;
+    }
+    pc.hosts = p.hosts;
+    pc.next_host = 0;
+    pc.port = p.port;
+  }
   return kOk;
 }
 
@@ -701,6 +732,21 @@ void TcpTransport::HandleConnection(int fd) {
         }
       }
       barrier_cv_.notify_all();
+      continue;
+    }
+    if (req.op == kOpPing) {
+      // Control-plane liveness probe: a bare ok. Served on this
+      // connection's own thread, so a busy data lane never delays it;
+      // no fault-injector draw (the gate above lists data ops only).
+      WireResp resp{kOk, 0, 0};
+      if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+      continue;
+    }
+    if (req.op == kOpVarSeq) {
+      // Shard content-version query (the mirror-refresh gate):
+      // resp.nbytes carries the update_seq, -1 when unknown.
+      WireResp resp{kOk, 0, store_ ? store_->UpdateSeqOf(name) : -1};
+      if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
       continue;
     }
     if (req.op == kOpCmaInfo) {
@@ -956,6 +1002,127 @@ int TcpTransport::Read(int target, const std::string& name, int64_t offset,
   return ReadV(target, name, &op, 1);
 }
 
+namespace {
+// Bounded dial for the heartbeat control plane: non-blocking connect +
+// poll, so a dead or blackholed peer costs at most `timeout_ms` — never
+// the kernel SYN timeout (the data path's blocking dial is bounded by
+// DDSTORE_CONNECT_TIMEOUT_S, far too long for a sub-second detector).
+int DialWithTimeout(const sockaddr* addr, socklen_t alen,
+                    long timeout_ms) {
+  int fd = ::socket(addr->sa_family, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  const int flags = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  if (::connect(fd, addr, alen) != 0) {
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return -1;
+    }
+    pollfd p{fd, POLLOUT, 0};
+    if (::poll(&p, 1, static_cast<int>(timeout_ms)) <= 0) {
+      ::close(fd);
+      return -1;
+    }
+    int err = 0;
+    socklen_t el = sizeof(err);
+    if (::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &el) != 0 ||
+        err != 0) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  ::fcntl(fd, F_SETFL, flags);
+  return fd;
+}
+}  // namespace
+
+int TcpTransport::EnsureControlConn(PingConn& pc, long timeout_ms) {
+  if (pc.fd >= 0) return pc.fd;
+  // Rotate across every advertised NIC address: a multi-homed peer
+  // whose first NIC is down must not read as dead while its data lanes
+  // (round-robin over the same list) still work.
+  for (size_t attempt = 0; attempt < pc.hosts.size(); ++attempt) {
+    const std::string& host = pc.hosts[pc.next_host % pc.hosts.size()];
+    addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    char portstr[16];
+    std::snprintf(portstr, sizeof(portstr), "%d", pc.port);
+    int fd = -1;
+    if (::getaddrinfo(host.c_str(), portstr, &hints, &res) == 0 && res) {
+      for (addrinfo* ai = res; ai && fd < 0; ai = ai->ai_next)
+        fd = DialWithTimeout(ai->ai_addr, ai->ai_addrlen, timeout_ms);
+      ::freeaddrinfo(res);
+    }
+    if (fd >= 0) {
+      SetNoDelay(fd);
+      pc.fd = fd;
+      return fd;
+    }
+    ++pc.next_host;  // next probe tries the peer's next address
+  }
+  return -1;
+}
+
+bool TcpTransport::ControlRoundTrip(PingConn& pc, uint32_t op,
+                                    const std::string& name,
+                                    long timeout_ms, void* resp) {
+  auto fail = [&]() {
+    if (pc.fd >= 0) {
+      ::close(pc.fd);
+      pc.fd = -1;
+    }
+    return false;
+  };
+  if (EnsureControlConn(pc, timeout_ms) < 0) return false;
+  timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  ::setsockopt(pc.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(pc.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  WireReq req{kMagic, op, rank_,
+              static_cast<uint32_t>(name.size()), 0, 0, 0};
+  if (FullSend(pc.fd, &req, sizeof(req)) != 0) return fail();
+  if (!name.empty() &&
+      FullSend(pc.fd, name.data(), name.size()) != 0)
+    return fail();
+  if (FullRecv(pc.fd, resp, sizeof(WireResp)) != 0) return fail();
+  if (static_cast<WireResp*>(resp)->status != kOk) return fail();
+  return true;
+}
+
+bool TcpTransport::Ping(int target, long timeout_ms) {
+  if (target < 0 || target >= world_ || target == rank_) return true;
+  if (timeout_ms < 50) timeout_ms = 50;
+  PingConn& pc = *ping_conns_[target];
+  // Blocking lock: a concurrent ReadVarSeq holds this for at most its
+  // own bounded round-trip, and a contended probe must WAIT and then
+  // truly measure — returning "alive" for a probe that never ran would
+  // reset the failure streak and stretch detection past the
+  // HEARTBEAT_MS * SUSPECT_N bound the tests assert.
+  std::lock_guard<std::mutex> lock(pc.mu);
+  // Endpoints not exchanged yet: liveness is undecidable, and the
+  // detector must not raise suspects during bootstrap.
+  if (pc.port < 0 || pc.hosts.empty()) return true;
+  WireResp resp;
+  return ControlRoundTrip(pc, kOpPing, std::string(), timeout_ms,
+                          &resp);
+}
+
+int64_t TcpTransport::ReadVarSeq(int target, const std::string& name) {
+  if (target < 0 || target >= world_ || target == rank_) return -1;
+  PingConn& pc = *ping_conns_[target];
+  std::lock_guard<std::mutex> lock(pc.mu);
+  if (pc.port < 0 || pc.hosts.empty()) return -1;
+  WireResp resp;
+  if (!ControlRoundTrip(pc, kOpVarSeq, name, /*timeout_ms=*/1000,
+                        &resp))
+    return -1;
+  return resp.nbytes;
+}
+
 int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
                           const ReadOp* ops, int64_t n) {
   std::lock_guard<std::mutex> lock(c.mu);
@@ -1137,6 +1304,22 @@ int TcpTransport::ReadVOnRetry(Peer& p, int lane0, int nlanes,
   if (nlanes < 1) nlanes = 1;
   int att = 0;
   Conn* used = p.conns[static_cast<size_t>(lane0)].get();
+  // Snapshot the store's suspect oracle ONCE per leaf (one uncontended
+  // lock amortized over the whole pipelined frame sequence); the
+  // per-attempt checks below are then plain calls into the store's
+  // relaxed atomic flags, never a shared mutex on the hot path.
+  std::function<bool(int)> oracle;
+  {
+    std::lock_guard<std::mutex> lock(oracle_mu_);
+    oracle = suspect_oracle_;
+  }
+  std::function<bool()> suspect;
+  if (oracle)
+    // Detector verdict aborts the ladder without a giveup: the
+    // failover layer reroutes this stripe onto the peer's replica set
+    // in O(heartbeat) instead of O(deadline). Unset oracle (no store
+    // attached / single-rank) = never suspected.
+    suspect = [o = std::move(oracle), target]() { return o(target); };
   const int rc = RetryTransientLoop(
       retry_, target, &stopping_,
       static_cast<uint64_t>(target) * 0x9e3779b97f4a7c15ULL +
@@ -1153,7 +1336,8 @@ int TcpTransport::ReadVOnRetry(Peer& p, int lane0, int nlanes,
           retry_.reconnects.fetch_add(1, std::memory_order_relaxed);
         ++att;  // rotate: the next attempt runs on the next lane
       },
-      retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9);
+      retry_deadline_ns_.load(std::memory_order_relaxed) * 1e-9,
+      suspect);
   if (rc == kErrPeerLost && DebugOn())
     std::fprintf(stderr, "[dds r%d] read to r%d exhausted retry budget "
                  "-> peer lost\n", rank_, target);

@@ -181,6 +181,30 @@ class TcpTransport : public Transport {
   // Every read leaf carries its own bounded reconnect-and-retry (see
   // ReadVOnRetry); the Store must not add a second layer on top.
   bool RetriesInternally() const override { return true; }
+  // Heartbeat probe on a DEDICATED control-plane connection (never a
+  // data lane: a lane mutex held across a long striped read would read
+  // as death; and ping frames draw nothing from the data path's fault
+  // injector — seeded chaos schedules are identical detector on/off).
+  bool Ping(int target, long timeout_ms) override;
+  // Content-version probe of a peer's shard, over the SAME dedicated
+  // control-plane connection the heartbeat uses (never a data lane, no
+  // fault-injector draw). -1 on any failure — the mirror refresh then
+  // pulls unconditionally, the safe default.
+  int64_t ReadVarSeq(int target, const std::string& name) override;
+  // The leaf retry layer's most recent failed target (failover names
+  // the dead member of a multi-peer batch with this).
+  int last_failed_peer() const override {
+    int64_t out[7];
+    retry_.Snapshot(out);
+    return static_cast<int>(out[6]);
+  }
+  // The store's suspect view, consulted between leaf retry attempts so
+  // a ladder against a detector-declared-dead peer aborts in
+  // O(heartbeat) instead of O(deadline).
+  void SetSuspectOracle(std::function<bool(int)> oracle) override {
+    std::lock_guard<std::mutex> lock(oracle_mu_);
+    suspect_oracle_ = std::move(oracle);
+  }
   // Per-store deadline share (see Store::SetRetryDeadline): applied to
   // every leaf's RetryTransientLoop while set.
   void SetRetryDeadline(double seconds) override {
@@ -285,6 +309,47 @@ class TcpTransport : public Transport {
 
   std::vector<std::unique_ptr<Peer>> peers_;
   std::vector<std::string> local_addrs_;
+
+  // Heartbeat control plane: one dedicated connection per peer, dialed
+  // lazily with a bounded non-blocking connect. Never shared with data
+  // lanes (see Ping above). UpdatePeer closes the slot so a replacement
+  // process gets a fresh dial.
+  // hosts/port are the ping thread's OWN endpoint copy, updated under
+  // `mu` by SetPeers/UpdatePeer — the data path's Peer fields are
+  // guarded by the lane mutexes, which the ping must never touch.
+  // EVERY advertised NIC address is kept and the dial rotates across
+  // them on failure: a multi-homed peer whose first NIC is down must
+  // not read as dead while its data lanes (round-robin over the same
+  // list) still work.
+  struct PingConn {
+    int fd = -1;
+    std::vector<std::string> hosts;
+    size_t next_host = 0;
+    int port = -1;
+    std::mutex mu;
+  };
+  std::vector<std::unique_ptr<PingConn>> ping_conns_;
+  // Shared dial/ensure half of Ping/ReadVarSeq: returns the connected
+  // control fd (dialing within timeout_ms if needed, rotating across
+  // the peer's advertised addresses on failure) or -1. Caller holds
+  // pc.mu.
+  int EnsureControlConn(PingConn& pc, long timeout_ms);
+  // One control-plane request/response over the peer's dedicated
+  // connection (the shared body of Ping and ReadVarSeq): sends `op`
+  // (+ name for ops that carry one), receives `resp`. False on any
+  // failure (connection closed for a fresh redial). Caller holds
+  // pc.mu.
+  bool ControlRoundTrip(PingConn& pc, uint32_t op,
+                        const std::string& name, long timeout_ms,
+                        void* resp);
+
+  // Store-installed suspect oracle for the leaf retry layer (null =
+  // never suspected). ReadVOnRetry snapshots it ONCE per leaf under
+  // oracle_mu_ (set-once at store construction; the lock only guards
+  // against an in-flight leaf racing SetSuspectOracle) — the
+  // per-attempt suspect checks are then lock-free.
+  std::mutex oracle_mu_;
+  std::function<bool(int)> suspect_oracle_;
 
   // Leaf read tasks (one per peer-connection stripe) run here; threads are
   // created lazily and persist for the transport's lifetime.

@@ -75,9 +75,16 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_COORDINATOR", "config"),
     _k("DDSTORE_DEBUG", "config"),
     _k("DDSTORE_DRYRUN_TIMEOUT_S", "config"),
+    _k("DDSTORE_FAILOVER_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_FAULT_RANKS", "config"),
     _k("DDSTORE_FAULT_SEED", "config"),
     _k("DDSTORE_FAULT_SPEC", "config"),
+    _k("DDSTORE_HEARTBEAT_MS", "config",
+       desc="heartbeat ping interval (ms); unset = 250 when "
+            "DDSTORE_REPLICATION > 1, else off; 0 disables"),
+    _k("DDSTORE_HEARTBEAT_SUSPECT_N", "config",
+       desc="consecutive missed pings before a peer is suspected "
+            "(default 3)"),
     _k("DDSTORE_HOST", "config"),
     _k("DDSTORE_IFACES", "config"),
     _k("DDSTORE_LANES_PHASE_TIMEOUT_S", "config"),
@@ -90,6 +97,11 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_RANK", "config"),
     _k("DDSTORE_RDV_DIR", "config"),
     _k("DDSTORE_RDV_ID", "config"),
+    _k("DDSTORE_REPLICATION", "config",
+       desc="R-way shard replication: each rank mirrors the next R-1 "
+            "ranks' shards, reads fail over transparently; default 1 "
+            "(off, byte-identical to the unreplicated tree); RAM cost "
+            "is R x the dataset"),
     _k("DDSTORE_READ_TIMEOUT_S", "config"),
     _k("DDSTORE_RETRY_BASE_MS", "config"),
     _k("DDSTORE_RETRY_MAX", "config"),

@@ -398,7 +398,17 @@ class Scheduler:
     def snapshot(self) -> Dict:
         """The ``summary()["sched"]`` payload: enablement, the current
         joint plan, predicted vs measured throughput, pins, replan
-        triggers and the core-budget regime."""
+        triggers, the core-budget regime and the peer-liveness view the
+        plan was built against (a dead peer's replan reason reads
+        ``peer_change`` — the heartbeat detector fires the same
+        listener elastic recovery does)."""
+        suspected: List[int] = []
+        if self.store is not None:
+            try:
+                suspected = [r for r, s in
+                             enumerate(self.store.health_state()) if s]
+            except Exception:
+                suspected = []
         with self._mu:
             plan = self._plan
             # Measured side of predicted-vs-measured: the host
@@ -422,4 +432,5 @@ class Scheduler:
                 "no_core_headroom": self.no_core_headroom,
                 "cores": self.model.cores,
                 "peers": self.model.peers,
+                "suspected_peers": suspected,
             }

@@ -237,8 +237,12 @@ class DDStore:
         self._endpoints = None
         self._generation = 0
         # Peer-topology listeners (see add_peer_listener): the cost-model
-        # scheduler replans when elastic recovery swaps an endpoint.
+        # scheduler replans when elastic recovery swaps an endpoint OR
+        # the heartbeat detector suspects a peer (check_health).
         self._peer_listeners = []
+        # Suspect view already delivered to listeners (check_health
+        # fires them only on CHANGE).
+        self._known_suspects = frozenset()
         if backend == "local":
             gid = self.group.broadcast(uuid.uuid4().hex)
             self._gid = gid
@@ -302,6 +306,7 @@ class DDStore:
         # ddstore.hpp:56-62); completing it with a barrier gives the same
         # guarantee: once any rank returns, every shard is readable.
         self.barrier()
+        self._replicate_after_add(name)
 
     def init(self, name: str, nrows: int, sample_shape: Tuple[int, ...],
              dtype) -> None:
@@ -319,6 +324,31 @@ class DDStore:
         self._meta[name] = _VarMeta(dtype, tuple(sample_shape), disp,
                                     all_nrows)
         self.barrier()
+        self._replicate_after_add(name)
+
+    def _replicate_after_add(self, name: str) -> None:
+        """R-way shard replication (``DDSTORE_REPLICATION``): after the
+        registration barrier every rank pulls read-only mirrors of the
+        next R-1 ranks' shards (chain placement), then a second barrier
+        makes the replica chain live before any read can need it.
+        No-op (and byte-identical to the pre-replication tree) at the
+        default R=1. A failed mirror pull is DEGRADED COVERAGE, not a
+        failed add: raising here would skip the trailing barrier and
+        stall every healthy rank in it — the replica router already
+        tolerates a missing mirror (next holder / classified loss), and
+        ``refresh_mirrors`` or the next epoch fence retries the pull."""
+        if self.replication > 1 and self.world > 1:
+            try:
+                self._native.replicate(name)
+            except DDStoreError as e:
+                import warnings
+
+                warnings.warn(
+                    f"add({name}): mirror replication incomplete on "
+                    f"rank {self.rank} ({e}); reads stay correct, "
+                    f"failover coverage is reduced until the next "
+                    f"refresh", RuntimeWarning, stacklevel=3)
+            self.barrier()
 
     def update(self, name: str, arr: np.ndarray, row_offset: int = 0) -> None:
         """Overwrite local rows [row_offset, row_offset+len(arr)) (reference
@@ -417,10 +447,14 @@ class DDStore:
             pass
         preview = ", ".join(str(int(r)) for r in lost[:4])
         more = "..." if len(lost) > 4 else ""
+        r = self.replication
+        how = (f"owner rank {peer} and all {r - 1} mirror holder(s) "
+               f"unreachable" if r > 1
+               else f"owner rank {peer} unreachable after bounded "
+                    f"retries")
         err = DDStoreError(
             e.code,
-            f"{name}: owner rank {peer} unreachable after bounded "
-            f"retries; {len(lost)} requested rows lost "
+            f"{name}: {how}; {len(lost)} requested rows lost "
             f"(rows {preview}{more}) — invoke elastic.recover")
         return err
 
@@ -696,6 +730,69 @@ class DDStore:
         wires this in as ``summary()["faults"]``."""
         return self._native.fault_stats()
 
+    # -- replication / failover / health ----------------------------------
+
+    @property
+    def replication(self) -> int:
+        """Replication factor in force (``DDSTORE_REPLICATION`` clamped
+        to ``[1, world]``). At R > 1 every rank hosts read-only mirrors
+        of the next R-1 ranks' shards; reads to a dead/suspected peer
+        transparently fail over to its replica chain, and
+        ``kErrPeerLost`` fires only when all R holders are gone."""
+        return self._native.replication
+
+    def replica_set(self, owner: int) -> list:
+        """Replica chain of ``owner``'s shard, primary first (chain
+        placement: ``[owner, owner-1, ..., owner-R+1] mod world``)."""
+        return self._native.replica_set(owner)
+
+    def refresh_mirrors(self) -> None:
+        """Re-pull every mirror this rank hosts, creating missing ones
+        — the elastic-recovery rebuild (collective discipline is the
+        caller's; :func:`elastic.recover`/``rejoin`` barrier around
+        it). Suspected owners are skipped: their mirror keeps the last
+        good bytes, which is exactly the copy failover is serving."""
+        self._native.refresh_mirrors()
+
+    def health_state(self) -> list:
+        """Per-peer suspicion flags (heartbeat verdicts ∪ data-path
+        ladder give-ups), one bool per rank."""
+        return self._native.health_state()
+
+    def suspected_peers(self) -> list:
+        """Ranks currently suspected dead."""
+        return [r for r, s in enumerate(self.health_state()) if s]
+
+    def mark_suspect(self, target: int, suspected: bool = True) -> None:
+        """Force a peer into (or out of) the suspect set (test hook;
+        the failover router short-circuits suspected peers)."""
+        self._native.mark_suspect(target, suspected)
+
+    def heartbeat_configure(self, interval_ms: int,
+                            suspect_n: int = 0) -> None:
+        """(Re)start the heartbeat detector (``interval_ms`` <= 0
+        stops it; ``suspect_n`` <= 0 keeps the env/default)."""
+        self._native.heartbeat_configure(interval_ms, suspect_n)
+
+    def failover_stats(self) -> dict:
+        """Replicated-read failover + heartbeat counters (see
+        :data:`binding.FAILOVER_STAT_KEYS`). Monotone except the
+        gauges; ``DeviceLoader.metrics`` wires this in as
+        ``summary()["failover"]``."""
+        return self._native.failover_stats()
+
+    def check_health(self) -> list:
+        """Poll the liveness view and fire the peer listeners exactly
+        once per NEW suspect (the scheduler replans routes/lanes off a
+        dead peer immediately instead of at the next deadline burn).
+        Returns the newly suspected ranks."""
+        now = frozenset(self.suspected_peers())
+        fresh = sorted(now - self._known_suspects)
+        self._known_suspects = now
+        if fresh:
+            self._fire_peer_listeners()
+        return fresh
+
     def set_retry_deadline(self, seconds: float) -> None:
         """Override this store's transient-retry deadline (seconds;
         ``<= 0`` restores ``DDSTORE_OP_DEADLINE_S``). The degraded
@@ -766,9 +863,14 @@ class DDStore:
     def update_peer(self, target: int, host: str, port: int) -> None:
         """Re-point one peer at a new endpoint (elastic recovery) and
         notify peer listeners (scheduler replan). Native side closes the
-        stale connections, re-probes CMA, resets the adaptive tuners and
-        releases every planner pin."""
+        stale connections, re-probes CMA, resets the adaptive tuners,
+        releases every planner pin and clears the peer's suspicion (the
+        replacement gets a clean liveness slate)."""
         self._native.update_peer(target, host, port)
+        self._known_suspects = self._known_suspects - {target}
+        self._fire_peer_listeners()
+
+    def _fire_peer_listeners(self) -> None:
         # Prune dead listeners first (a collected Scheduler advertises
         # its death via the closure's `alive` attribute) — long-lived
         # stores see one registration per discarded loader.

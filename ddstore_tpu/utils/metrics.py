@@ -174,6 +174,13 @@ class PipelineMetrics:
         self._fault_mu = threading.Lock()
         self._fault_events: Dict[str, int] = \
             {k: 0 for k in self.FAULT_EVENT_KEYS}
+        # Replicated-read failover ledger: a cumulative-counter source
+        # (DDStore.failover_stats) snapshotted at epoch boundaries —
+        # summary()["failover"] is how an epoch record proves "peer
+        # died, replicas served, zero give-ups" on its own.
+        self._failover_source: Optional[Callable[[], Dict]] = None
+        self._failover_begin: Optional[Dict] = None
+        self._failover_end: Optional[Dict] = None
         # (bytes, fetch_s) per window, for the honest per-window best
         # bandwidth (bounded: one entry per window, windows are O(epoch
         # batches / W)).
@@ -243,6 +250,43 @@ class PipelineMetrics:
                             self._fault_begin.get(k, 0)))
         with self._fault_mu:
             out.update(self._fault_events)
+        return out
+
+    #: gauge keys of the failover source (reported raw, never delta'd —
+    #: keep in sync with binding.FAILOVER_GAUGE_KEYS).
+    FAILOVER_GAUGES = ("replication", "hb_active", "suspected_now")
+
+    def set_failover_source(self,
+                            source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning cumulative failover /
+        heartbeat counters (``DDStore.failover_stats``). Snapshotted at
+        epoch boundaries; ``summary()["failover"]`` reports per-epoch
+        deltas (gauges raw)."""
+        self._failover_source = source
+
+    def _snap_failover(self) -> Optional[Dict]:
+        if self._failover_source is None:
+            return None
+        try:
+            return dict(self._failover_source())
+        except Exception:
+            return None
+
+    def failover_summary(self) -> Dict:
+        """Per-epoch failover view: counter deltas + the live gauges."""
+        out: Dict = {}
+        if self._failover_begin is None:
+            return out
+        end = self._failover_end if self._failover_end is not None \
+            else self._snap_failover()
+        if end is None:
+            return out
+        for k in end:
+            if k in self.FAILOVER_GAUGES:
+                out[k] = int(end[k])
+            else:
+                out[k] = max(0, int(end[k]) - int(
+                    self._failover_begin.get(k, 0)))
         return out
 
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
@@ -380,6 +424,8 @@ class PipelineMetrics:
         self._plan_end = None
         self._fault_begin = self._snap_faults()
         self._fault_end = None
+        self._failover_begin = self._snap_failover()
+        self._failover_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -398,6 +444,7 @@ class PipelineMetrics:
         self._t_end = time.perf_counter()
         self._plan_end = self._snap_plan()
         self._fault_end = self._snap_faults()
+        self._failover_end = self._snap_failover()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -440,6 +487,16 @@ class PipelineMetrics:
         # any degradation event fired.
         if self._fault_begin is not None or any(faults.values()):
             out["faults"] = faults
+        fo = self.failover_summary()
+        # Included when replication is actually in force (an R>1 epoch
+        # with zero failovers is the "nobody died" result a failover
+        # A/B reads) or any failover/suspicion activity fired under R=1
+        # heartbeat-only setups.
+        if fo and (fo.get("replication", 1) > 1
+                   or fo.get("hb_active", 0)
+                   or any(v for k, v in fo.items()
+                          if k not in self.FAILOVER_GAUGES)):
+            out["failover"] = fo
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself

@@ -24,7 +24,8 @@ NATIVE = os.path.join(HERE, "ddstore_tpu", "native")
 # Keep in sync with ddstore_tpu/_build.py _SOURCES (not imported: pulling
 # in the package here would trigger its lazy native build mid-setup).
 SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
-           "worker_pool.cc", "cma.cc", "fault.cc", "capi.cc"]
+           "worker_pool.cc", "cma.cc", "fault.cc", "health.cc",
+           "capi.cc"]
 
 
 def compile_native(out_dir: str) -> str:

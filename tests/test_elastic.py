@@ -53,24 +53,48 @@ else:
         while True:  # "train" until the harness SIGKILLs us
             read_all(store)
             time.sleep(0.02)
-    # Survivors: keep reading until the death surfaces as an error.
-    deadline = time.time() + 60
-    while True:
-        try:
-            read_all(store)
+    if store.replication > 1:
+        # Replication-enabled survivors KEEP TRAINING through the
+        # death: no read may fail (every lost row is served from its
+        # replica), and detection is the heartbeat's, not an error —
+        # the rendezvous stall the unreplicated path pays is gone.
+        deadline = time.time() + 60
+        while victim not in store.suspected_peers():
+            read_all(store)  # raises = the failover contract broke
             time.sleep(0.02)
-        except DDStoreError as e:
-            print("DETECTED", type(e).__name__, flush=True)
-            break
-        if time.time() > deadline:
-            print("NEVER_DETECTED", flush=True)
-            sys.exit(2)
+            if time.time() > deadline:
+                print("NEVER_SUSPECTED", flush=True)
+                sys.exit(2)
+        for _ in range(5):  # post-death: still byte-identical
+            read_all(store)
+        assert store.failover_stats()["failover_reads"] >= 1
+        print("SURVIVED_THROUGH_DEATH", flush=True)
+    else:
+        # Unreplicated survivors: keep reading until the death
+        # surfaces as an error.
+        deadline = time.time() + 60
+        while True:
+            try:
+                read_all(store)
+                time.sleep(0.02)
+            except DDStoreError as e:
+                print("DETECTED", type(e).__name__, flush=True)
+                break
+            if time.time() > deadline:
+                print("NEVER_DETECTED", flush=True)
+                sys.exit(2)
     elastic_recover(store, eroot, timeout=60)
     print("RECOVERED", flush=True)
 
 # New world: every global row must be served again (the victim's rows now
 # come from the replacement's checkpoint restore)...
 read_all(store)
+# ...with the replication factor RESTORED: rejoin/recover rebuilt the
+# mirror chains, so a second death immediately after recovery is
+# already covered again (pinned by the mirror traffic counter).
+if store.replication > 1:
+    assert store.failover_stats()["mirror_fills"] >= 1
+    assert not any(store.health_state()), store.health_state()
 # ...the control plane must be alive for NEW collectives...
 store.add("w", np.full((4, 2), (rank + 1) * 10.0, np.float64))
 idx = np.arange(world * 4)
@@ -83,12 +107,13 @@ print("DONE", rank, flush=True)
 """
 
 
-@pytest.mark.parametrize("victim", [2, 0])
-def test_elastic_inrun_recovery(tmp_path, victim):
+@pytest.mark.parametrize("victim,replication", [(2, 1), (0, 1), (2, 2)])
+def test_elastic_inrun_recovery(tmp_path, victim, replication):
     world = 4
     env = dict(os.environ,
                DDSTORE_WORLD=str(world),
                DDSTORE_VICTIM=str(victim),
+               DDSTORE_REPLICATION=str(replication),
                DDSTORE_RDV_DIR=str(tmp_path / "rdv"),
                DDSTORE_ELASTIC_DIR=str(tmp_path / "elastic"),
                DDSTORE_CKPT_DIR=str(tmp_path / "ckpt"),
@@ -128,6 +153,11 @@ def test_elastic_inrun_recovery(tmp_path, victim):
             assert b"DONE %d" % r in out, out.decode(errors="replace")
             if r == victim:
                 assert b"REJOINED" in out
+            elif replication > 1:
+                # Survivors trained THROUGH the death (no read error,
+                # no rendezvous stall) before recovering.
+                assert b"SURVIVED_THROUGH_DEATH" in out and \
+                    b"RECOVERED" in out, out.decode(errors="replace")
             else:
                 assert b"DETECTED" in out and b"RECOVERED" in out
     finally:

@@ -182,6 +182,60 @@ ts = [threading.Thread(target=lane_worker, args=(r, errs))
 [t.start() for t in ts]
 [t.join() for t in ts]
 assert not errs, errs
+
+# Failover paths under the sanitizer (ISSUE 7 satellite): a replicated
+# store loses a peer MID-STRIPE with an async window read in flight —
+# the read must fail over to the replica, release its ticket
+# (async_pending()==0), free every stripe's scratch, and ~Store must
+# free the mirror shards with everything else (heartbeat thread joined
+# first).
+os.environ["DDSTORE_REPLICATION"] = "2"
+os.environ["DDSTORE_HEARTBEAT_MS"] = "25"
+os.environ["DDSTORE_HEARTBEAT_SUSPECT_N"] = "2"
+os.environ["DDSTORE_RETRY_MAX"] = "2"
+os.environ["DDSTORE_OP_DEADLINE_S"] = "3"
+os.environ["DDSTORE_CONNECT_TIMEOUT_S"] = "1"
+os.environ["DDSTORE_READ_TIMEOUT_S"] = "2"
+fault_configure("", 0)
+FAILNAME = uuid.uuid4().hex
+FWORLD, FNROWS, FDIM = 3, 16, 1 << 15  # 256 KiB rows: striped frames
+
+fo_stores = {}
+fo_ready = threading.Barrier(FWORLD)
+
+def failover_worker(rank, errs):
+    try:
+        group = ThreadGroup(FAILNAME, rank, FWORLD)
+        s = DDStore(group, backend="tcp")
+        fo_stores[rank] = s
+        s.add("v", np.full((FNROWS, FDIM), rank + 1, np.float64))
+        fo_ready.wait()
+        if rank != 0:
+            return  # shards/mirrors served by the store until teardown
+        idx = np.arange(FWORLD * FNROWS)
+        want = (idx // FNROWS + 1)[:, None]
+        # Async batched read in flight while owner 1 dies mid-stripe;
+        # the replica (rank 0's own mirror) completes it.
+        h = s.get_batch_async("v", idx)
+        fo_stores[1]._native.close()
+        got = h.wait()
+        assert (got == want).all()
+        assert s.async_pending() == 0, s.async_pending()
+        # Post-death failover read (suspect latched or ladder verdict).
+        got2 = s.get_batch("v", idx)
+        assert (got2 == want).all()
+        assert s.failover_stats()["failover_reads"] >= 1
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=failover_worker, args=(r, errs))
+      for r in range(FWORLD)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
+for s in fo_stores.values():
+    s._native.close()  # idempotent for the dead rank; frees mirrors
 print("stress ok")
 """
 
