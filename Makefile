@@ -6,7 +6,15 @@
 
 PYTHON ?= python
 
-.PHONY: native native-force clean-native test
+.PHONY: native native-force clean-native test lint
+
+# ddlint: the repo-native concurrency & contract analyzer (lock
+# discipline over the DDS_* annotations, capi<->binding parity, knob
+# registry, tier1 skip paths). Exit 1 on any finding not pinned in
+# ddstore_tpu/analysis/baseline.json. Same pass tier-1 runs in
+# tests/test_static_analysis.py, so a CI lint failure reproduces here.
+lint:
+	$(PYTHON) -m ddstore_tpu.analysis
 
 native:
 	$(PYTHON) -m ddstore_tpu._build

@@ -21,14 +21,19 @@ _SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
             "capi.cc"]
 _HEADERS = ["store.h", "local_transport.h", "tcp_transport.h",
             "worker_pool.h", "cma.h", "fault.h", "health.h",
-            "measure.h"]
+            "measure.h", "thread_annotations.h"]
 _lock = threading.Lock()
 
 # Sanitizer builds (SURVEY §5: the reference has no TSan/ASan anywhere; the
 # shared_mutex-heavy core + serving threads are exactly the code that needs
-# them). DDSTORE_SANITIZE=thread|address selects a separately-cached .so so
-# plain and sanitized builds don't evict each other.
-_SANITIZERS = {"thread": "-fsanitize=thread", "address": "-fsanitize=address"}
+# them). DDSTORE_SANITIZE=thread|address|undefined selects a
+# separately-cached .so so plain and sanitized builds don't evict each
+# other. `undefined` (UBSan, ISSUE 8 satellite) catches the shift/
+# overflow/alignment class the wire-framing and offset arithmetic are
+# full of — and unlike TSan it does not hang under this gVisor kernel.
+_SANITIZERS = {"thread": "-fsanitize=thread",
+               "address": "-fsanitize=address",
+               "undefined": "-fsanitize=undefined"}
 
 
 def _sanitize_mode() -> str:

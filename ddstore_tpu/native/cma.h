@@ -50,6 +50,7 @@
 #include <string>
 
 #include "store.h"
+#include "thread_annotations.h"
 
 namespace dds {
 
@@ -137,20 +138,25 @@ class CmaRegistry {
   bool FreeData(void* base);
 
  private:
-  CmaSlot* FindSlot(uint64_t h, bool take_empty);
+  CmaSlot* FindSlot(uint64_t h, bool take_empty) DDS_REQUIRES(mu_);
 
   struct DataFile {
     uint64_t id;
     int64_t len;
   };
 
-  std::mutex mu_;  // one writer process, many writer threads
+  // One writer process, many writer threads. Registration/teardown
+  // path: shm file creation under it is accepted (not a hot-path
+  // mutex). Ordered after the store's registry lock (PublishVar runs
+  // under Store::mu_).
+  std::mutex mu_;
   CmaSegment* seg_ = nullptr;
   std::string shm_name_;
   int fd_ = -1;
   std::once_flag reads_enabled_;
-  std::map<void*, DataFile> data_;  // AllocData'd shard backings
-  uint64_t next_data_id_ = 0;
+  // AllocData'd shard backings
+  std::map<void*, DataFile> data_ DDS_GUARDED_BY(mu_);
+  uint64_t next_data_id_ DDS_GUARDED_BY(mu_) = 0;
 };
 
 // Reader side: a peer's mapped segment + pid.
@@ -221,7 +227,7 @@ class CmaPeer {
   uint64_t start_time_;
   const std::string shm_name_;
   std::mutex maps_mu_;
-  std::map<uint64_t, DataMap> maps_;
+  std::map<uint64_t, DataMap> maps_ DDS_GUARDED_BY(maps_mu_);
   std::atomic<int64_t> reads_since_check_{0};
   std::atomic<int64_t> last_live_ns_{0};
   std::atomic<bool> denied_{false};

@@ -31,6 +31,8 @@
 #include <string>
 #include <vector>
 
+#include "thread_annotations.h"
+
 namespace dds {
 
 enum class FaultKind : int {
@@ -90,9 +92,9 @@ class FaultInjector {
   };
 
   mutable std::mutex mu_;  // guards rules_/ranks_/seed_ (reconfiguration)
-  std::vector<Rule> rules_;
-  std::vector<int> ranks_;  // empty = all ranks
-  uint64_t seed_ = 0;
+  std::vector<Rule> rules_ DDS_GUARDED_BY(mu_);
+  std::vector<int> ranks_ DDS_GUARDED_BY(mu_);  // empty = all ranks
+  uint64_t seed_ DDS_GUARDED_BY(mu_) = 0;
   std::atomic<bool> enabled_{false};
   std::atomic<uint64_t> n_{0};  // draw counter
   std::atomic<int64_t> c_checks_{0}, c_reset_{0}, c_trunc_{0}, c_delay_{0},

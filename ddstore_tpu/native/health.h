@@ -28,6 +28,8 @@
 #include <mutex>
 #include <thread>
 
+#include "thread_annotations.h"
+
 namespace dds {
 
 class HealthMonitor {
@@ -81,8 +83,12 @@ class HealthMonitor {
  private:
   void Loop();
 
-  mutable std::mutex mu_;  // guards start/stop + config
-  std::thread thread_;
+  // Guards start/stop + config. The loop thread reads its config
+  // (interval_ms_/suspect_n_/pinger_) unlocked: written only in Start,
+  // which joins any previous thread first — happens-before by thread
+  // creation, not by lock.
+  mutable std::mutex mu_;
+  std::thread thread_ DDS_GUARDED_BY(mu_);
   std::atomic<bool> stop_{false};
   std::atomic<bool> running_{false};
   int rank_ = 0;

@@ -17,6 +17,7 @@
 #include <vector>
 
 #include "store.h"
+#include "thread_annotations.h"
 
 namespace dds {
 
@@ -53,9 +54,9 @@ class LocalGroup {
   const int world_;
   std::mutex mu_;
   std::condition_variable cv_;
-  std::vector<Store*> members_;
-  std::vector<bool> ever_registered_;
-  std::map<int64_t, BarrierState> barriers_;
+  std::vector<Store*> members_ DDS_GUARDED_BY(mu_);
+  std::vector<bool> ever_registered_ DDS_GUARDED_BY(mu_);
+  std::map<int64_t, BarrierState> barriers_ DDS_GUARDED_BY(mu_);
 };
 
 class LocalTransport : public Transport {

@@ -1002,15 +1002,16 @@ int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,
 }
 
 int Store::EpochBegin() {
+  int64_t tag;
   {
     std::unique_lock<std::shared_mutex> lock(mu_);
     if (fence_active_) return kErrEpochState;
     fence_active_ = true;
-    ++epoch_tag_;
+    tag = ++epoch_tag_;
   }
   int rc = kOk;
   if (epoch_collective_ && world() > 1)
-    rc = transport_->Barrier((epoch_tag_ << 1) | 0);
+    rc = transport_->Barrier((tag << 1) | 0);
   // Mirror refresh rides the epoch fence: Update()s applied since the
   // last fence become failover-visible here (the paper's
   // update/epoch_begin contract). Content-version-gated — a static
@@ -1023,13 +1024,15 @@ int Store::EpochBegin() {
 }
 
 int Store::EpochEnd() {
+  int64_t tag;
   {
     std::unique_lock<std::shared_mutex> lock(mu_);
     if (!fence_active_) return kErrEpochState;
     fence_active_ = false;
+    tag = epoch_tag_;
   }
   if (epoch_collective_ && world() > 1)
-    return transport_->Barrier((epoch_tag_ << 1) | 1);
+    return transport_->Barrier((tag << 1) | 1);
   return kOk;
 }
 

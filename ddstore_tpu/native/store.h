@@ -33,6 +33,7 @@
 
 #include "fault.h"
 #include "health.h"
+#include "thread_annotations.h"
 
 namespace dds {
 
@@ -569,17 +570,20 @@ class Store {
 
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
-  mutable std::shared_mutex mu_;
-  std::map<std::string, VarInfo> vars_;
+  // Acquired before the CMA registry's mutex: Add/Update/Rebind/Free
+  // publish shard mappings (Transport::PublishVar -> CmaRegistry) while
+  // holding the exclusive lock.
+  mutable std::shared_mutex mu_ DDS_ACQUIRED_BEFORE(CmaRegistry::mu_);
+  std::map<std::string, VarInfo> vars_ DDS_GUARDED_BY(mu_);
   std::unique_ptr<Transport> transport_;
-  bool fence_active_ = false;
+  bool fence_active_ DDS_GUARDED_BY(mu_) = false;
   bool epoch_collective_ = true;
-  int64_t epoch_tag_ = 0;
+  int64_t epoch_tag_ DDS_GUARDED_BY(mu_) = 0;
 
   // Scatter-read planner statistics (GetBatch runs concurrently; a plain
   // mutex is fine — one lock per batch, not per row).
-  mutable std::mutex stats_mu_;
-  PlanStats stats_;
+  mutable std::mutex stats_mu_ DDS_NO_BLOCKING;
+  PlanStats stats_ DDS_GUARDED_BY(stats_mu_);
 
   // Store-level transient-retry accounting (see RetryTransient).
   RetryStats retry_;
@@ -593,9 +597,10 @@ class Store {
   struct AsyncState {
     std::mutex mu;
     std::condition_variable cv;
-    bool done = false;
-    int rc = kOk;
-    double done_mono_s = 0.0;  // CLOCK_MONOTONIC completion time
+    bool done DDS_GUARDED_BY(AsyncState::mu) = false;
+    int rc DDS_GUARDED_BY(AsyncState::mu) = kOk;
+    // CLOCK_MONOTONIC completion time
+    double done_mono_s DDS_GUARDED_BY(AsyncState::mu) = 0.0;
   };
   void DrainAsync();  // ~Store: finish every in-flight read, drop the pool
   // Synchronous body of ReadRunsAsync, run on the async pool.
@@ -608,24 +613,33 @@ class Store {
   int64_t SubmitAsync(std::function<int()> fn);
   // Admit the next deferred async reads while running < width. Caller
   // holds async_mu_.
-  void PumpAsyncLocked();
-  mutable std::mutex async_mu_;
-  int64_t next_ticket_ = 1;
-  std::map<int64_t, std::shared_ptr<AsyncState>> async_;
-  std::unique_ptr<WorkerPool> async_pool_;  // lazily created, at a fixed
+  void PumpAsyncLocked() DDS_REQUIRES(async_mu_);
+  // Async issue/completion hot path: no getenv or other blocking call
+  // may run under it (AsyncWidth() reads pre-resolved atomics only).
+  // Acquired before the async pool's queue mutex (Submit runs under it).
+  mutable std::mutex async_mu_ DDS_NO_BLOCKING
+      DDS_ACQUIRED_BEFORE(WorkerPool::mu_);
+  int64_t next_ticket_ DDS_GUARDED_BY(async_mu_) = 1;
+  std::map<int64_t, std::shared_ptr<AsyncState>> async_
+      DDS_GUARDED_BY(async_mu_);
+  std::unique_ptr<WorkerPool> async_pool_
+      DDS_GUARDED_BY(async_mu_);  // lazily created, at a fixed
   // generous thread cap; the ADMISSION width (how many reads run at
   // once) is enforced here via async_running_/async_deferred_ so the
   // scheduler can change it at runtime (SetAsyncWidth). Default width:
   // DDSTORE_ASYNC_THREADS, else the 4/2/1 core ladder.
   std::atomic<int> async_width_override_{0};  // 0 = env/ladder default
   int async_default_ = 2;  // env/ladder default, resolved at construction
-  int async_running_ = 0;  // reads admitted to the pool (async_mu_)
-  std::deque<std::function<void()>> async_deferred_;  // awaiting a slot
+  // reads admitted to the pool
+  int async_running_ DDS_GUARDED_BY(async_mu_) = 0;
+  // awaiting a slot
+  std::deque<std::function<void()>> async_deferred_
+      DDS_GUARDED_BY(async_mu_);
 
   // Heartbeat failure detector + suspect registry. Declared LAST so it
   // is destroyed FIRST (reverse member order): the ping thread must be
   // joined before the transport it pings goes away.
-  HealthMonitor health_;
+  HealthMonitor health_ DDS_DESTROYED_BEFORE(transport_);
 };
 
 }  // namespace dds

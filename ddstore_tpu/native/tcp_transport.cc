@@ -512,12 +512,22 @@ int TcpTransport::SetPeers(const std::vector<std::string>& hosts,
       static_cast<int>(ports.size()) != world_)
     return kErrInvalidArg;
   for (int i = 0; i < world_; ++i) {
-    peers_[i]->hosts = SplitCsv(hosts[i]);
-    if (peers_[i]->hosts.empty()) return kErrInvalidArg;
-    peers_[i]->port = ports[i];
+    std::vector<std::string> hlist = SplitCsv(hosts[i]);
+    if (hlist.empty()) return kErrInvalidArg;
+    Peer& p = *peers_[i];
+    {
+      // Endpoint writes hold EVERY conn mutex — the same discipline
+      // UpdatePeer uses (EnsureConnected reads hosts/port under its
+      // own lane's mutex). Uncontended at bootstrap; ddlint-enforced.
+      std::vector<std::unique_lock<std::mutex>> locks;
+      locks.reserve(p.conns.size());
+      for (auto& c : p.conns) locks.emplace_back(c->mu);
+      p.hosts = hlist;
+      p.port = ports[i];
+    }
     PingConn& pc = *ping_conns_[i];
     std::lock_guard<std::mutex> lock(pc.mu);
-    pc.hosts = peers_[i]->hosts;
+    pc.hosts = std::move(hlist);
     pc.next_host = 0;
     pc.port = ports[i];
   }
@@ -559,8 +569,8 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
       }
       c->uds_tried = false;  // the replacement may offer the Unix lane
     }
-    p.hosts = std::move(hosts);
-    p.port = port;
+    p.hosts = hosts;  // keep the local: the PingConn update below must
+    p.port = port;    // not re-read p.* outside the conn mutexes
   }
   {
     // The replacement is a different process: its CMA mapping table and
@@ -621,9 +631,9 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
       ::close(pc.fd);
       pc.fd = -1;
     }
-    pc.hosts = p.hosts;
+    pc.hosts = std::move(hosts);
     pc.next_host = 0;
-    pc.port = p.port;
+    pc.port = port;
   }
   return kOk;
 }

@@ -31,6 +31,7 @@
 #include "cma.h"
 #include "measure.h"
 #include "store.h"
+#include "thread_annotations.h"
 #include "worker_pool.h"
 
 namespace dds {
@@ -185,12 +186,17 @@ class TcpTransport : public Transport {
   // data lane: a lane mutex held across a long striped read would read
   // as death; and ping frames draw nothing from the data path's fault
   // injector — seeded chaos schedules are identical detector on/off).
-  bool Ping(int target, long timeout_ms) override;
+  // The EXCLUDES set is the machine-readable form of "never hold a
+  // data-lane mutex during Ping": acquiring any data-path mutex here
+  // fails lint.
+  bool Ping(int target, long timeout_ms) override
+      DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // Content-version probe of a peer's shard, over the SAME dedicated
   // control-plane connection the heartbeat uses (never a data lane, no
   // fault-injector draw). -1 on any failure — the mirror refresh then
   // pulls unconditionally, the safe default.
-  int64_t ReadVarSeq(int target, const std::string& name) override;
+  int64_t ReadVarSeq(int target, const std::string& name) override
+      DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // The leaf retry layer's most recent failed target (failover names
   // the dead member of a multi-peer batch with this).
   int last_failed_peer() const override {
@@ -232,39 +238,51 @@ class TcpTransport : public Transport {
   // read actually engages is governed by the lane autotuner (LaneTuner
   // below) unless DDSTORE_TCP_LANES_AUTOTUNE=0 pins it at the pool size.
   struct Conn {
-    int fd = -1;
+    int fd DDS_GUARDED_BY(Conn::mu) = -1;
     int idx = 0;    // position in the pool; picks the NIC pairing
     // Same-host fast lane: whether this slot already probed the peer's
     // Unix-domain listener (probe once; a failed probe falls back to TCP
     // permanently until UpdatePeer swaps the endpoint).
-    bool uds_tried = false;
-    std::mutex mu;  // serializes use of this connection
+    bool uds_tried DDS_GUARDED_BY(Conn::mu) = false;
+    std::mutex mu;  // serializes use of this connection (a data-lane
+    //                 mutex: legitimately held across blocking wire
+    //                 I/O, so deliberately NOT DDS_NO_BLOCKING — the
+    //                 control plane instead EXCLUDES it, see Ping)
     // Response payload bytes this lane has carried (per-peer per-lane
     // observability: lane utilization/balance is diagnosable from the
     // BENCH json alone). Atomic: LaneBytes snapshots without taking mu.
     std::atomic<int64_t> bytes{0};
   };
   struct Peer {
-    std::vector<std::string> hosts;  // one entry per advertised NIC
-    int port = -1;
+    // Endpoint table: written under ALL of the peer's conn mutexes
+    // (SetPeers/UpdatePeer), read by EnsureConnected under its one —
+    // any single Conn::mu is a read guard, the full set the write
+    // guard. The analyzer models this at class granularity.
+    std::vector<std::string> hosts
+        DDS_GUARDED_BY(Conn::mu);  // one entry per advertised NIC
+    int port DDS_GUARDED_BY(Conn::mu) = -1;
     std::vector<std::unique_ptr<Conn>> conns;
     // CMA (same-host process_vm_readv) state: 0 = unprobed, 1 = usable,
-    // -1 = TCP only. Probed lazily on first read to the peer.
-    std::mutex cma_mu;
-    int cma_state = 0;
-    std::unique_ptr<CmaPeer> cma;
+    // -1 = TCP only. Probed lazily on first read to the peer. The
+    // one-shot probe inside EnsureCmaPeer blocks under this mutex by
+    // design (baselined): concurrent classification peeks wait out the
+    // first probe's bounded info exchange.
+    std::mutex cma_mu DDS_NO_BLOCKING DDS_ACQUIRED_BEFORE(Conn::mu);
+    int cma_state DDS_GUARDED_BY(cma_mu) = 0;
+    std::unique_ptr<CmaPeer> cma DDS_GUARDED_BY(cma_mu);
     // CmaPeers retired by UpdatePeer (elastic recovery). Raw pointers
     // returned by EnsureCmaPeer may still be mid-TryReadV on pool
     // threads with no lock held, so a retired peer is parked here —
     // alive but inert (reads against the dead pid fail fast) — and
     // freed at transport teardown. Bounded: one entry per recovery.
-    std::vector<std::unique_ptr<CmaPeer>> cma_retired;
+    std::vector<std::unique_ptr<CmaPeer>> cma_retired
+        DDS_GUARDED_BY(cma_mu);
   };
 
   // Probe/return the peer's CMA mapping (nullptr = use TCP).
   CmaPeer* EnsureCmaPeer(Peer& p, int target);
 
-  int EnsureConnected(Peer& p, Conn& c);
+  int EnsureConnected(Peer& p, Conn& c) DDS_REQUIRES(Conn::mu);
   // The pipelined request/response loop over one connection.
   int ReadVOn(Peer& p, Conn& c, const std::string& name, const ReadOp* ops,
               int64_t n);
@@ -291,7 +309,8 @@ class TcpTransport : public Transport {
 
   int listen_fd_ = -1;
   int server_port_ = -1;
-  std::thread accept_thread_;
+  std::thread accept_thread_;  // joined first in ~TcpTransport (freezes
+  //                              conn_fds_/conn_threads_ growth)
   // Same-host fast lane: a second listener on an abstract-namespace
   // Unix-domain socket named after the TCP port (which is unique per
   // network namespace, so the name cannot collide between instances).
@@ -304,8 +323,8 @@ class TcpTransport : public Transport {
   std::thread uds_accept_thread_;
   std::atomic<int64_t> uds_conns_{0};  // UDS dials that succeeded
   std::mutex conns_mu_;
-  std::vector<std::thread> conn_threads_;
-  std::vector<int> conn_fds_;
+  std::vector<std::thread> conn_threads_ DDS_GUARDED_BY(conns_mu_);
+  std::vector<int> conn_fds_ DDS_GUARDED_BY(conns_mu_);
 
   std::vector<std::unique_ptr<Peer>> peers_;
   std::vector<std::string> local_addrs_;
@@ -322,18 +341,20 @@ class TcpTransport : public Transport {
   // not read as dead while its data lanes (round-robin over the same
   // list) still work.
   struct PingConn {
-    int fd = -1;
-    std::vector<std::string> hosts;
-    size_t next_host = 0;
-    int port = -1;
-    std::mutex mu;
+    int fd DDS_GUARDED_BY(PingConn::mu) = -1;
+    std::vector<std::string> hosts DDS_GUARDED_BY(PingConn::mu);
+    size_t next_host DDS_GUARDED_BY(PingConn::mu) = 0;
+    int port DDS_GUARDED_BY(PingConn::mu) = -1;
+    std::mutex mu;  // control-plane round trips are bounded by their
+    //                 own timeout; blocking under it is the design
   };
   std::vector<std::unique_ptr<PingConn>> ping_conns_;
   // Shared dial/ensure half of Ping/ReadVarSeq: returns the connected
   // control fd (dialing within timeout_ms if needed, rotating across
   // the peer's advertised addresses on failure) or -1. Caller holds
   // pc.mu.
-  int EnsureControlConn(PingConn& pc, long timeout_ms);
+  int EnsureControlConn(PingConn& pc, long timeout_ms)
+      DDS_REQUIRES(PingConn::mu);
   // One control-plane request/response over the peer's dedicated
   // connection (the shared body of Ping and ReadVarSeq): sends `op`
   // (+ name for ops that carry one), receives `resp`. False on any
@@ -341,15 +362,15 @@ class TcpTransport : public Transport {
   // pc.mu.
   bool ControlRoundTrip(PingConn& pc, uint32_t op,
                         const std::string& name, long timeout_ms,
-                        void* resp);
+                        void* resp) DDS_REQUIRES(PingConn::mu);
 
   // Store-installed suspect oracle for the leaf retry layer (null =
   // never suspected). ReadVOnRetry snapshots it ONCE per leaf under
   // oracle_mu_ (set-once at store construction; the lock only guards
   // against an in-flight leaf racing SetSuspectOracle) — the
   // per-attempt suspect checks are then lock-free.
-  std::mutex oracle_mu_;
-  std::function<bool(int)> suspect_oracle_;
+  std::mutex oracle_mu_ DDS_NO_BLOCKING;
+  std::function<bool(int)> suspect_oracle_ DDS_GUARDED_BY(oracle_mu_);
 
   // Leaf read tasks (one per peer-connection stripe) run here; threads are
   // created lazily and persist for the transport's lifetime.
@@ -368,7 +389,7 @@ class TcpTransport : public Transport {
   // latency wherever process_vm_readv works at all). One estimate per
   // transport, not per peer: the decision only matters on same-host
   // peers, which all share one kernel. Guarded by route_mu_.
-  std::mutex route_mu_;
+  std::mutex route_mu_ DDS_NO_BLOCKING;
   // One adaptive preference per traffic class: "bulk" (>= kBulkBytes in
   // one request — bandwidth-dominated) and "scatter" (many small ops,
   // modest bytes — per-op-overhead-dominated; a DistributedSampler
@@ -411,8 +432,10 @@ class TcpTransport : public Transport {
     // sat inside the hysteresis band forever.
     bool calibrated = false;
   };
-  RouteClass bulk_route_{"bulk", "DDSTORE_CMA_BULK", 1.25, 0};
-  RouteClass scatter_route_{"scattered", "DDSTORE_CMA_SCATTER", 1.10, 1};
+  RouteClass bulk_route_ DDS_GUARDED_BY(route_mu_){
+      "bulk", "DDSTORE_CMA_BULK", 1.25, 0};
+  RouteClass scatter_route_ DDS_GUARDED_BY(route_mu_){
+      "scattered", "DDSTORE_CMA_SCATTER", 1.10, 1};
   unsigned hw_cores_ = 1;  // CMA striping is CPU-bound; never deal more
   //                          part-lists than cores (a 1-core box pays
   //                          pure dispatch overhead for each extra part)
@@ -452,9 +475,9 @@ class TcpTransport : public Transport {
     //                            measure.h rule 1, per-tuner budget)
     int64_t samples = 0;       // clean samples folded (observability)
   };
-  std::mutex lane_mu_;
-  LaneTuner bulk_lanes_;
-  LaneTuner scatter_lanes_;
+  std::mutex lane_mu_ DDS_NO_BLOCKING;
+  LaneTuner bulk_lanes_ DDS_GUARDED_BY(lane_mu_);
+  LaneTuner scatter_lanes_ DDS_GUARDED_BY(lane_mu_);
   // Lanes the NEXT striped read of the class should engage (the parked
   // count, or the level currently being measured).
   int StripeLanes(LaneTuner& t);
@@ -503,11 +526,12 @@ class TcpTransport : public Transport {
   // high-water mark of completed/timed-out seqs, and late notifies at or
   // below it are dropped so a straggler can't repopulate an erased entry
   // and leak it (seqs are never reused).
-  std::mutex barrier_mu_;
+  std::mutex barrier_mu_ DDS_NO_BLOCKING;
   std::condition_variable barrier_cv_;
-  std::map<std::pair<int64_t, int>, int> barrier_arrived_;
-  int64_t barrier_seq_ = 0;
-  int64_t retired_seq_ = 0;
+  std::map<std::pair<int64_t, int>, int> barrier_arrived_
+      DDS_GUARDED_BY(barrier_mu_);
+  int64_t barrier_seq_ DDS_GUARDED_BY(barrier_mu_) = 0;
+  int64_t retired_seq_ DDS_GUARDED_BY(barrier_mu_) = 0;
 };
 
 }  // namespace dds

@@ -24,6 +24,8 @@
 #include <thread>
 #include <vector>
 
+#include "thread_annotations.h"
+
 namespace dds {
 
 class WorkerPool {
@@ -52,12 +54,14 @@ class WorkerPool {
   void WorkerLoop();
 
   const int max_threads_;
-  std::mutex mu_;
+  // Queue mutex: dispatch hot path (one acquisition per burst), no
+  // blocking call may run under it.
+  std::mutex mu_ DDS_NO_BLOCKING;
   std::condition_variable cv_;
-  std::deque<std::function<void()>> queue_;
-  std::vector<std::thread> threads_;
-  int idle_ = 0;
-  bool stopping_ = false;
+  std::deque<std::function<void()>> queue_ DDS_GUARDED_BY(mu_);
+  std::vector<std::thread> threads_ DDS_GUARDED_BY(mu_);
+  int idle_ DDS_GUARDED_BY(mu_) = 0;
+  bool stopping_ DDS_GUARDED_BY(mu_) = false;
 };
 
 // Tracks a batch of tasks submitted to a pool; Wait() blocks until all
@@ -78,9 +82,9 @@ class TaskGroup {
 
  private:
   struct State {
-    std::mutex mu;
+    std::mutex mu;  // no blocking under it: completion-count bumps only
     std::condition_variable cv;
-    int64_t pending = 0;
+    int64_t pending DDS_GUARDED_BY(State::mu) = 0;
   };
   WorkerPool* pool_;
   std::shared_ptr<State> state_;

@@ -73,6 +73,8 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
        "entirely (a capability switch, not a per-class preference)"),
     _k("DDSTORE_CONNECT_TIMEOUT_S", "config"),
     _k("DDSTORE_COORDINATOR", "config"),
+    _k("DDSTORE_CXX", "config",
+       desc="C++ compiler for the on-demand native build (default g++)"),
     _k("DDSTORE_DEBUG", "config"),
     _k("DDSTORE_DRYRUN_TIMEOUT_S", "config"),
     _k("DDSTORE_FAILOVER_PHASE_TIMEOUT_S", "config"),
@@ -89,11 +91,16 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_IFACES", "config"),
     _k("DDSTORE_LANES_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_METHOD", "config"),
+    _k("DDSTORE_NUM_PROCESSES", "config",
+       desc="explicit pod size for pod_bootstrap (with "
+            "DDSTORE_COORDINATOR/DDSTORE_PROCESS_ID)"),
     _k("DDSTORE_OP_DEADLINE_S", "config"),
     _k("DDSTORE_PEAK_FLOPS", "config"),
     _k("DDSTORE_POD_AUTODETECT", "config"),
     _k("DDSTORE_POOL_THREADS", "config"),
     _k("DDSTORE_PPSCHED_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_PROCESS_ID", "config",
+       desc="explicit pod process index for pod_bootstrap"),
     _k("DDSTORE_RANK", "config"),
     _k("DDSTORE_RDV_DIR", "config"),
     _k("DDSTORE_RDV_ID", "config"),

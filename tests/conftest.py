@@ -35,6 +35,15 @@ from ddstore_tpu import _build  # noqa: E402
 _build.build()
 
 
+def pytest_report_header(config):
+    """Point at the one-command local reproduction for the static
+    analyzer's tier-1 gate (tests/test_static_analysis.py): a lint
+    failure in CI is `make lint` here, no pytest invocation needed."""
+    from ddstore_tpu.analysis import baseline_path
+    return (f"ddlint: `make lint` reproduces the static-analysis gate; "
+            f"baseline at {os.path.relpath(baseline_path())}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -241,7 +241,8 @@ print("stress ok")
 
 
 def _sanitizer_lib(mode):
-    name = {"thread": "libtsan.so", "address": "libasan.so"}[mode]
+    name = {"thread": "libtsan.so", "address": "libasan.so",
+            "undefined": "libubsan.so"}[mode]
     out = subprocess.run(["g++", f"-print-file-name={name}"],
                          capture_output=True, text=True)
     path = out.stdout.strip()
@@ -257,6 +258,11 @@ def _sanitizer_lib(mode):
     # tier-1 representative (it passes in ~30 s).
     pytest.param("thread", marks=pytest.mark.slow),
     "address",
+    # UBSan (ISSUE 8 satellite): gcc 10 supports -fsanitize=undefined
+    # and, unlike TSan, it runs fine under gVisor. Same subprocess
+    # stress scenario; catches the shift/overflow/alignment/bounds
+    # class that the wire framing's int64 offset arithmetic risks.
+    "undefined",
 ])
 def test_native_stress_under_sanitizer(mode, tmp_path):
     lib = _sanitizer_lib(mode)
@@ -270,6 +276,8 @@ def test_native_stress_under_sanitizer(mode, tmp_path):
     env["TSAN_OPTIONS"] = "exitcode=66 halt_on_error=1"
     env["ASAN_OPTIONS"] = ("detect_leaks=0 exitcode=66 "
                            "allocator_may_return_null=1")
+    env["UBSAN_OPTIONS"] = ("exitcode=66 halt_on_error=1 "
+                            "print_stacktrace=1")
     proc = subprocess.run([sys.executable, "-c", _STRESS],
                           capture_output=True, text=True, env=env,
                           timeout=600, cwd=os.path.dirname(
@@ -278,4 +286,5 @@ def test_native_stress_under_sanitizer(mode, tmp_path):
     assert proc.returncode == 0, report[-4000:]
     assert "WARNING: ThreadSanitizer" not in report, report[-4000:]
     assert "ERROR: AddressSanitizer" not in report, report[-4000:]
+    assert "runtime error:" not in report, report[-4000:]  # UBSan
     assert "stress ok" in report
