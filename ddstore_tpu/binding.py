@@ -66,17 +66,20 @@ def _load() -> ctypes.CDLL:
                                ctypes.c_void_p, _i64, _i64]
     lib.dds_get.restype = ctypes.c_int
     lib.dds_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
-                            _i64, _i64]
+                            _i64, _i64, ctypes.c_char_p]
     lib.dds_get_batch.restype = ctypes.c_int
     lib.dds_get_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                  ctypes.c_void_p, _i64p, _i64]
+                                  ctypes.c_void_p, _i64p, _i64,
+                                  ctypes.c_char_p]
     lib.dds_get_batch_async.restype = _i64
     lib.dds_get_batch_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.c_void_p, _i64p, _i64]
+                                        ctypes.c_void_p, _i64p, _i64,
+                                        ctypes.c_char_p]
     lib.dds_read_runs_async.restype = _i64
     lib.dds_read_runs_async.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                         ctypes.c_void_p, _i64p, _i64p,
-                                        _i64p, _i64p, _i64]
+                                        _i64p, _i64p, _i64,
+                                        ctypes.c_char_p]
     lib.dds_async_wait.restype = ctypes.c_int
     lib.dds_async_wait.argtypes = [ctypes.c_void_p, _i64, _i64,
                                    ctypes.POINTER(ctypes.c_double)]
@@ -129,6 +132,29 @@ def _load() -> ctypes.CDLL:
     lib.dds_set_async_width.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dds_async_width.restype = ctypes.c_int
     lib.dds_async_width.argtypes = [ctypes.c_void_p]
+    lib.dds_tenant_set_quota.restype = ctypes.c_int
+    lib.dds_tenant_set_quota.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         _i64, _i64]
+    lib.dds_tenant_set_share.restype = ctypes.c_int
+    lib.dds_tenant_set_share.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+    lib.dds_tenant_set_lane_budget.restype = ctypes.c_int
+    lib.dds_tenant_set_lane_budget.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p,
+                                               ctypes.c_int]
+    lib.dds_tenant_names.restype = ctypes.c_int
+    lib.dds_tenant_names.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+    lib.dds_tenant_stats.restype = ctypes.c_int
+    lib.dds_tenant_stats.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     _i64p]
+    lib.dds_snapshot_acquire.restype = _i64
+    lib.dds_snapshot_acquire.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_char_p]
+    lib.dds_snapshot_release.restype = ctypes.c_int
+    lib.dds_snapshot_release.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_snapshot_stats.restype = ctypes.c_int
+    lib.dds_snapshot_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_replication.restype = ctypes.c_int
     lib.dds_replication.argtypes = [ctypes.c_void_p]
     lib.dds_replicate.restype = ctypes.c_int
@@ -175,6 +201,9 @@ def _load() -> ctypes.CDLL:
 ERR_TRANSPORT = -6   # transient-class transport failure
 ERR_PEER_LOST = -10  # transient-retry budget exhausted: owner presumed
 #                      dead — fatal, invoke elastic.recover
+ERR_QUOTA = -11      # tenant byte/var budget exhausted at registration:
+#                      admission refused — nothing died, free variables
+#                      or raise the quota (distinct from ERR_PEER_LOST)
 
 
 class DDStoreError(RuntimeError):
@@ -254,6 +283,23 @@ FAILOVER_STAT_KEYS = (
 
 #: the gauge subset of :data:`FAILOVER_STAT_KEYS` (never delta'd).
 FAILOVER_GAUGE_KEYS = ("replication", "hb_active", "suspected_now")
+
+
+#: dict keys of :meth:`NativeStore.tenant_stats`, in native layout
+#: order (keep in sync with capi dds_tenant_stats /
+#: Store::TenantCounters). ``quota_bytes``/``quota_vars``/``bytes``/
+#: ``vars``/``snapshot_pins``/``share`` are GAUGES; the rest is
+#: monotone since store creation (PipelineMetrics diffs those per
+#: epoch into ``summary()["tenants"]``).
+TENANT_STAT_KEYS = (
+    "quota_bytes", "quota_vars", "bytes", "vars", "quota_rejections",
+    "read_bytes", "reads", "served_bytes", "served_reads",
+    "async_admitted", "async_deferred", "snapshot_pins", "share",
+)
+
+#: the gauge subset of :data:`TENANT_STAT_KEYS` (never delta'd).
+TENANT_GAUGE_KEYS = ("quota_bytes", "quota_vars", "bytes", "vars",
+                     "snapshot_pins", "share")
 
 
 #: dict keys of :meth:`NativeStore.fault_stats`, in native layout order.
@@ -435,6 +481,81 @@ class NativeStore:
         the 4/2/1 core-ladder default)."""
         return int(self._lib.dds_async_width(self._h))
 
+    # -- tenant namespaces / quotas / snapshot epochs ----------------------
+
+    def tenant_set_quota(self, tenant: str, max_bytes: int,
+                         max_vars: int = -1) -> None:
+        """Byte/var budget for ``tenant`` (< 0 = unlimited). Checked
+        atomically at add/init registration; over-budget registrations
+        raise :data:`ERR_QUOTA` — a distinct, non-fatal class."""
+        _check(self._lib.dds_tenant_set_quota(
+            self._h, tenant.encode(), int(max_bytes), int(max_vars)),
+            f"tenant_set_quota({tenant})")
+
+    def tenant_set_share(self, tenant: str, share: int) -> None:
+        """Async-admission weight (>= 1): with any share configured,
+        ``tenant`` runs at most ``max(1, width * share / total)``
+        concurrent async batched reads; excess defers and admits as
+        slots free (ticket contract unchanged)."""
+        _check(self._lib.dds_tenant_set_share(
+            self._h, tenant.encode(), int(share)),
+            f"tenant_set_share({tenant})")
+
+    def tenant_set_lane_budget(self, tenant: str, lanes: int) -> None:
+        """QoS lane budget: striped reads of ``tenant``'s variables
+        engage at most ``lanes`` transport lanes (<= 0 clears). No-op
+        on non-TCP backends."""
+        _check(self._lib.dds_tenant_set_lane_budget(
+            self._h, tenant.encode(), int(lanes)),
+            f"tenant_set_lane_budget({tenant})")
+
+    def tenant_names(self) -> list:
+        """Every tenant the store has seen (config or traffic). A
+        leading separator marks the DEFAULT tenant "" — a CSV of plain
+        labels cannot otherwise carry it."""
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.dds_tenant_names(self._h, buf, cap)
+        if n <= 0:
+            return []
+        raw = buf.value.decode()
+        names = [""] if raw.startswith(",") else []
+        return names + [t for t in raw.split(",") if t]
+
+    def tenant_stats(self, tenant: str) -> dict:
+        """Ledger snapshot for one tenant (:data:`TENANT_STAT_KEYS`)."""
+        arr = (ctypes.c_int64 * 16)()
+        _check(self._lib.dds_tenant_stats(self._h, tenant.encode(), arr),
+               f"tenant_stats({tenant})")
+        return dict(zip(TENANT_STAT_KEYS,
+                        list(arr)[:len(TENANT_STAT_KEYS)]))
+
+    def snapshot_acquire(self, tenant: str = "") -> int:
+        """Pin the store-wide current shard versions; returns the
+        snapshot id the reader's scoped names carry. All-or-nothing: a
+        peer that cannot be pinned fails the acquire (pins already
+        placed are rolled back)."""
+        sid = self._lib.dds_snapshot_acquire(self._h, tenant.encode())
+        if sid <= 0:
+            raise DDStoreError(int(sid), "snapshot_acquire")
+        return int(sid)
+
+    def snapshot_release(self, snap_id: int) -> None:
+        """Release a snapshot everywhere; kept versions whose last pin
+        this was are reclaimed. Idempotent."""
+        _check(self._lib.dds_snapshot_release(self._h, int(snap_id)),
+               f"snapshot_release({snap_id})")
+
+    def snapshot_stats(self) -> dict:
+        """This rank's snapshot gauges: active pins, kept shard
+        versions and their RAM cost."""
+        arr = (ctypes.c_int64 * 4)()
+        _check(self._lib.dds_snapshot_stats(self._h, arr),
+               "snapshot_stats")
+        return {"active_snapshots": int(arr[0]),
+                "kept_versions": int(arr[1]),
+                "kept_bytes": int(arr[2])}
+
     # -- replication / failover / heartbeat -------------------------------
 
     @property
@@ -538,18 +659,20 @@ class NativeStore:
                                     nrows, row_offset), f"update({name})")
 
     def get(self, name: str, out: np.ndarray, start: int,
-            count: int) -> None:
+            count: int, tenant: str = "") -> None:
         assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
         _check(self._lib.dds_get(self._h, name.encode(), out.ctypes.data,
-                                 start, count), f"get({name}, {start})")
+                                 start, count, tenant.encode()),
+               f"get({name}, {start})")
 
     def get_batch(self, name: str, out: np.ndarray,
-                  starts: np.ndarray) -> None:
+                  starts: np.ndarray, tenant: str = "") -> None:
         assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
         starts = np.ascontiguousarray(starts, dtype=np.int64)
         _check(self._lib.dds_get_batch(self._h, name.encode(),
                                        out.ctypes.data, _as_i64p(starts),
-                                       len(starts)), f"get_batch({name})")
+                                       len(starts), tenant.encode()),
+               f"get_batch({name})")
 
     # -- async batched reads ----------------------------------------------
     #
@@ -560,19 +683,20 @@ class NativeStore:
     # copied at issue time.
 
     def get_batch_async(self, name: str, out: np.ndarray,
-                        starts: np.ndarray) -> int:
+                        starts: np.ndarray, tenant: str = "") -> int:
         assert out.flags["C_CONTIGUOUS"] and out.flags["WRITEABLE"]
         starts = np.ascontiguousarray(starts, dtype=np.int64)
         ticket = self._lib.dds_get_batch_async(
             self._h, name.encode(), out.ctypes.data, _as_i64p(starts),
-            len(starts))
+            len(starts), tenant.encode())
         if ticket < 0:
             raise DDStoreError(int(ticket), f"get_batch_async({name})")
         return int(ticket)
 
     def read_runs_async(self, name: str, out: np.ndarray,
                         targets: np.ndarray, src_off: np.ndarray,
-                        dst_off: np.ndarray, nbytes: np.ndarray) -> int:
+                        dst_off: np.ndarray, nbytes: np.ndarray,
+                        tenant: str = "") -> int:
         """Async vectored run read: the caller's pre-coalesced per-peer
         runs executed verbatim (O(runs), not O(rows)) — the readahead
         window fast path. Bounds of every dst span are validated here;
@@ -587,7 +711,8 @@ class NativeStore:
             raise ValueError("read_runs_async: dst span exceeds out")
         ticket = self._lib.dds_read_runs_async(
             self._h, name.encode(), out.ctypes.data, _as_i64p(arrs[0]),
-            _as_i64p(arrs[1]), _as_i64p(arrs[2]), _as_i64p(arrs[3]), n)
+            _as_i64p(arrs[1]), _as_i64p(arrs[2]), _as_i64p(arrs[3]), n,
+            tenant.encode())
         if ticket < 0:
             raise DDStoreError(int(ticket), f"read_runs_async({name})")
         return int(ticket)

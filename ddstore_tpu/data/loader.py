@@ -164,6 +164,13 @@ class DeviceLoader:
             # epoch that lost a rank proves "replicas served, zero
             # give-ups" from the record alone.
             self.metrics.set_failover_source(store.failover_stats)
+        if store is not None and hasattr(store, "tenant_stats"):
+            # Multi-tenant ledger: summary()["tenants"] carries each
+            # tenant's per-epoch quota rejections, admission/deferral
+            # counts and read/served traffic — a shared-service epoch
+            # proves its QoS behavior from the record alone. Inert
+            # (empty) on single-tenant stores.
+            self.metrics.set_tenant_source(store.tenant_stats)
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether

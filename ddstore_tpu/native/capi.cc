@@ -146,6 +146,71 @@ int dds_failover_stats(dds_handle* h, int64_t out[16]) {
   return dds::kOk;
 }
 
+// -- tenant namespaces / quotas / snapshot epochs -----------------------------
+
+// Byte/var budget for one tenant (< 0 = unlimited). Checked-and-
+// reserved atomically at add/init registration; kErrQuota (-11) on
+// exhaustion — classified distinctly from kErrPeerLost.
+int dds_tenant_set_quota(dds_handle* h, const char* tenant,
+                         int64_t max_bytes, int64_t max_vars) {
+  if (!h || !tenant) return dds::kErrInvalidArg;
+  return h->store->SetTenantQuota(tenant, max_bytes, max_vars);
+}
+
+// Async-admission weight (>= 1): with any share configured, tenant t
+// runs at most max(1, width * share_t / total) concurrent async reads.
+int dds_tenant_set_share(dds_handle* h, const char* tenant, int share) {
+  if (!h || !tenant) return dds::kErrInvalidArg;
+  return h->store->SetTenantShare(tenant, share);
+}
+
+// QoS lane budget for one tenant's striped reads (<= 0 clears). No-op
+// kOk on non-TCP backends (no lanes to budget).
+int dds_tenant_set_lane_budget(dds_handle* h, const char* tenant,
+                               int lanes) {
+  if (!h || !tenant) return dds::kErrInvalidArg;
+  if (!h->tcp) return dds::kOk;
+  return h->tcp->SetTenantLaneBudget(tenant, lanes);
+}
+
+// CSV of every tenant the store has seen; returns the length written.
+int dds_tenant_names(dds_handle* h, char* out, int cap) {
+  if (!h || !out || cap <= 0) return dds::kErrInvalidArg;
+  return h->store->TenantNames(out, cap);
+}
+
+// Per-tenant ledger snapshot. Layout (keep in sync with binding.py
+// TENANT_STAT_KEYS): [quota_bytes, quota_vars, bytes, vars,
+// quota_rejections, read_bytes, reads, served_bytes, served_reads,
+// async_admitted, async_deferred, snapshot_pins, share, 0, 0, 0].
+int dds_tenant_stats(dds_handle* h, const char* tenant,
+                     int64_t out[16]) {
+  if (!h || !tenant || !out) return dds::kErrInvalidArg;
+  return h->store->TenantCounters(tenant, out);
+}
+
+// Pin the store-wide current shard versions for a read-only snapshot
+// reader (local pin + a control op to every peer; all-or-nothing).
+// Returns a positive snapshot id, or a negative ErrorCode.
+int64_t dds_snapshot_acquire(dds_handle* h, const char* tenant) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->SnapshotAcquire(tenant ? tenant : "");
+}
+
+// Release a snapshot everywhere; kept versions whose last pin this was
+// are freed (dead peers best-effort).
+int dds_snapshot_release(dds_handle* h, int64_t snap_id) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->SnapshotRelease(snap_id);
+}
+
+// [active_snapshots, kept_versions, kept_bytes, 0] on THIS rank.
+int dds_snapshot_stats(dds_handle* h, int64_t out[4]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->SnapshotCounters(out);
+  return dds::kOk;
+}
+
 int dds_routing_state(dds_handle* h, int cls, double* cma_bw,
                       double* tcp_bw, int64_t* decisions,
                       int64_t* crossovers, int* via_tcp, int* calibrated) {
@@ -260,26 +325,39 @@ int dds_update(dds_handle* h, const char* name, const void* buf, int64_t nrows,
   return h->store->Update(name, buf, nrows, row_offset);
 }
 
+// `as_tenant` (nullable) names the READING handle for the per-tenant
+// read ledger; NULL/"" derives the tenant from the variable name.
 int dds_get(dds_handle* h, const char* name, void* dst, int64_t start,
-            int64_t count) {
+            int64_t count, const char* as_tenant) {
   if (!h) return dds::kErrInvalidArg;
-  return h->store->Get(name, dst, start, count);
+  return h->store->Get(name, dst, start, count,
+                       as_tenant ? as_tenant : "");
 }
 
+// `as_tenant` (nullable) names the READING handle for the per-tenant
+// read ledger and QoS lane budget; NULL/"" derives the tenant from the
+// variable name (the pre-tenancy behavior).
 int dds_get_batch(dds_handle* h, const char* name, void* dst,
-                  const int64_t* starts, int64_t n) {
+                  const int64_t* starts, int64_t n,
+                  const char* as_tenant) {
   if (!h) return dds::kErrInvalidArg;
-  return h->store->GetBatch(name, dst, starts, n);
+  return h->store->GetBatch(name, dst, starts, n,
+                            as_tenant ? as_tenant : "");
 }
 
 // Async batched reads (the epoch-readahead engine's native leg): issue a
 // GetBatch on the store's background pool, poll/wait, release. See
 // Store::GetBatchAsync for the contract (dst stays alive until the
 // ticket completes; Release blocks until the read finishes).
+// `as_tenant` (nullable) names the READING handle for QoS admission
+// and the per-tenant admitted/deferred ledger; NULL/"" derives the
+// tenant from the variable name (the pre-tenancy behavior).
 int64_t dds_get_batch_async(dds_handle* h, const char* name, void* dst,
-                            const int64_t* starts, int64_t n) {
+                            const int64_t* starts, int64_t n,
+                            const char* as_tenant) {
   if (!h) return dds::kErrInvalidArg;
-  return h->store->GetBatchAsync(name, dst, starts, n);
+  return h->store->GetBatchAsync(name, dst, starts, n,
+                                 as_tenant ? as_tenant : "");
 }
 
 // Async vectored run read (the readahead window fast path): executes
@@ -289,10 +367,11 @@ int64_t dds_read_runs_async(dds_handle* h, const char* name, void* dst,
                             const int64_t* targets,
                             const int64_t* src_off,
                             const int64_t* dst_off, const int64_t* nbytes,
-                            int64_t nruns) {
+                            int64_t nruns, const char* as_tenant) {
   if (!h) return dds::kErrInvalidArg;
   return h->store->ReadRunsAsync(name, dst, targets, src_off, dst_off,
-                                 nbytes, nruns);
+                                 nbytes, nruns,
+                                 as_tenant ? as_tenant : "");
 }
 
 // 1 = done ok; 0 = still in flight after timeout_ms (0 polls, negative

@@ -124,6 +124,14 @@ int64_t LocalTransport::ReadVarSeq(int target, const std::string& name) {
   return peer ? peer->UpdateSeqOf(name) : -1;
 }
 
+int LocalTransport::SnapshotControl(int target, int64_t snap_id,
+                                    bool pin, const std::string& tenant) {
+  Store* peer = group_->member(target);
+  if (!peer) return kErrTransport;
+  return pin ? peer->PinSnapshot(snap_id, tenant)
+             : peer->UnpinSnapshot(snap_id);
+}
+
 int LocalTransport::ReadV(int target, const std::string& name,
                           const ReadOp* ops, int64_t n) {
   // Peer resolution and the registry lookup happen once for the batch

@@ -84,6 +84,10 @@ class LocalTransport : public Transport {
   // Control-plane content-version probe (mirror refresh gate): direct
   // registry read of the peer store, no fault-injector draw.
   int64_t ReadVarSeq(int target, const std::string& name) override;
+  // Snapshot-epoch pin/release: direct call into the peer store's
+  // owner-side half (control plane, no fault-injector draw).
+  int SnapshotControl(int target, int64_t snap_id, bool pin,
+                      const std::string& tenant) override;
   int Barrier(int64_t tag) override { return group_->Barrier(tag); }
   int rank() const override { return rank_; }
   int world() const override { return group_->world(); }

@@ -59,8 +59,39 @@ const char* ErrorString(int code) {
     case kErrShapeMismatch: return "shape mismatch across ranks";
     case kErrPeerLost: return "peer unreachable (transient-retry budget "
                               "exhausted; owner presumed dead)";
+    case kErrQuota: return "tenant quota exceeded (admission refused; "
+                           "free variables or raise the budget)";
     default: return "unknown error";
   }
+}
+
+// -- tenant name scoping ------------------------------------------------------
+
+std::string TenantOfVarName(const std::string& name) {
+  // See through the hidden-variable wrappers so mirror pulls and
+  // snapshot reads attribute to the tenant owning the data underneath.
+  size_t pos = 0;
+  for (int depth = 0; depth < 4; ++depth) {  // wrappers never nest deeper
+    if (pos >= name.size()) return "";
+    const char c = name[pos];
+    if (c == '\x01' || c == '\x03') {
+      // "\x01mirror\x01<owner>\x01<rest>" / "\x03s\x03<id>\x03<rest>" /
+      // "\x03k\x03<seq>\x03<rest>": skip two more delimiters.
+      size_t p = name.find(c, pos + 1);
+      if (p == std::string::npos) return "";
+      p = name.find(c, p + 1);
+      if (p == std::string::npos) return "";
+      pos = p + 1;
+      continue;
+    }
+    if (c == '\x02') {
+      const size_t end = name.find('\x02', pos + 1);
+      if (end == std::string::npos) return "";
+      return name.substr(pos + 1, end - pos - 1);
+    }
+    return "";
+  }
+  return "";
 }
 
 namespace {
@@ -76,6 +107,35 @@ int ReplicationFromEnv(int world) {
 }
 }  // namespace
 
+namespace {
+// "tenant=value[,tenant=value...]" env specs (quota values additionally
+// carry an optional ":vars" suffix). Malformed entries are skipped —
+// config parsing must never fail store construction.
+void ParseTenantSpec(
+    const char* env,
+    const std::function<void(const std::string&, const std::string&)>& fn) {
+  if (!env) return;
+  const std::string s(env);
+  size_t pos = 0;
+  while (pos <= s.size()) {
+    size_t next = s.find(',', pos);
+    if (next == std::string::npos) next = s.size();
+    const std::string entry = s.substr(pos, next - pos);
+    const size_t eq = entry.find('=');
+    if (eq != std::string::npos && eq > 0) {
+      const std::string tenant = entry.substr(0, eq);
+      // Control characters collide with the native name-scoping and
+      // names-CSV wire formats — such a label is malformed, skip it.
+      bool ok = true;
+      for (const char c : tenant)
+        ok = ok && static_cast<unsigned char>(c) >= 0x20;
+      if (ok) fn(tenant, entry.substr(eq + 1));
+    }
+    pos = next + 1;
+  }
+}
+}  // namespace
+
 Store::Store(std::unique_ptr<Transport> transport)
     : transport_(std::move(transport)),
       // Resolved once per store (the pre-admission-gate code read the
@@ -84,6 +144,43 @@ Store::Store(std::unique_ptr<Transport> transport)
       // getenv/strtol there.
       async_default_(static_cast<int>(AsyncThreadsFromEnv())) {
   replication_ = ReplicationFromEnv(world());
+  // Tenant quotas/shares from the environment (runtime setters exist
+  // too). DDSTORE_TENANT_QUOTAS="t=bytes[:vars],..."
+  // DDSTORE_TENANT_SHARES="t=weight,...".
+  ParseTenantSpec(
+      std::getenv("DDSTORE_TENANT_QUOTAS"),
+      [this](const std::string& t, const std::string& v) {
+        char* end = nullptr;
+        const long long b = std::strtoll(v.c_str(), &end, 10);
+        if (end == v.c_str()) return;  // no bytes value: skip entry
+        long long nv = -1;
+        if (*end == ':') {
+          // Optional ":vars" suffix. A bare trailing ':' means
+          // unlimited (the Python parser agrees); junk after it skips
+          // the entry — it must NOT parse as quota_vars=0, which
+          // would refuse every registration for the tenant.
+          const char* vs = end + 1;
+          if (*vs) {
+            char* end2 = nullptr;
+            const long long parsed = std::strtoll(vs, &end2, 10);
+            if (end2 == vs || *end2) return;
+            nv = parsed;
+          }
+        } else if (*end) {
+          return;  // junk after the bytes value: skip entry
+        }
+        SetTenantQuota(t, b, nv);
+      });
+  ParseTenantSpec(
+      std::getenv("DDSTORE_TENANT_SHARES"),
+      [this](const std::string& t, const std::string& v) {
+        char* end = nullptr;
+        const long w = std::strtol(v.c_str(), &end, 10);
+        // Junk after the weight (e.g. a ';' typo for ',') skips the
+        // entry, matching the quotas parser and the Python mirror.
+        if (end != v.c_str() && !*end && w >= 1)
+          SetTenantShare(t, static_cast<int>(w));
+      });
   health_.Init(rank(), world());
   if (world() > 1) {
     // Transports with an internal retry layer (TCP leaves) consult the
@@ -117,11 +214,12 @@ void Store::DrainAsync() {
     std::lock_guard<std::mutex> lock(async_mu_);
     // Admission-deferred reads must still complete — a waiter in
     // AsyncRelease blocks on their AsyncState. Hand them all to the
-    // pool (ignoring the width; this is teardown): its dtor runs every
-    // queued task before joining.
+    // pool (ignoring width AND tenant shares; this is teardown): its
+    // dtor runs every queued task before joining.
     while (!async_deferred_.empty()) {
       ++async_running_;
-      async_pool_->Submit(std::move(async_deferred_.front()));
+      ++async_tenant_running_[async_deferred_.front().tenant];
+      async_pool_->Submit(std::move(async_deferred_.front().task));
       async_deferred_.pop_front();
     }
     pool = std::move(async_pool_);
@@ -146,30 +244,65 @@ int Store::AddInternal(const std::string& name, const void* buf, int64_t nrows,
                        const int64_t* all_nrows, bool copy, bool zero_fill) {
   if (name.empty() || disp <= 0 || itemsize <= 0 || nrows < 0)
     return kErrInvalidArg;
+  // Tenant admission: check-and-reserve the byte/var budget atomically
+  // BEFORE registration (leaf lock, never nested under mu_) and roll
+  // back on any failure below. Unscoped names skip this entirely
+  // unless the default tenant was explicitly configured — the default
+  // tree takes no tenant lock at all. The charge is the LARGEST rank's
+  // shard bytes: add() is collective and every rank sees the same
+  // all_nrows, so every rank reaches the SAME verdict — an uneven
+  // shard must never half-register (ERR_QUOTA on one rank, kOk and a
+  // stranded registration on another).
+  int64_t maxrows = 0;
+  for (int r = 0; r < world(); ++r)
+    if (all_nrows[r] > maxrows) maxrows = all_nrows[r];
+  const int64_t tbytes = maxrows * disp * itemsize;
+  std::string tenant;
+  bool reserved = false;
+  if (name[0] == '\x02' ||
+      track_default_tenant_.load(std::memory_order_relaxed)) {
+    {
+      // Classify a duplicate registration BEFORE the quota gate: an
+      // at-budget tenant re-adding an existing name must get
+      // kErrExists (the pre-tenancy answer), not a spurious
+      // kErrQuota + quota_rejections tick telling it to free/raise.
+      std::shared_lock<std::shared_mutex> rl(mu_);
+      if (vars_.count(name)) return kErrExists;
+    }
+    tenant = TenantOfVarName(name);
+    int qrc = TenantReserve(tenant, tbytes);
+    if (qrc != kOk) return qrc;
+    reserved = true;
+  }
+  auto fail = [&](int rc) {
+    if (reserved) TenantRelease(tenant, tbytes);
+    return rc;
+  };
   std::unique_lock<std::shared_mutex> lock(mu_);
-  if (vars_.count(name)) return kErrExists;
+  if (vars_.count(name)) return fail(kErrExists);
 
   VarInfo v;
   v.name = name;
   v.disp = disp;
   v.itemsize = itemsize;
   v.nrows = nrows;
+  if (reserved) v.quota_reserved = tbytes;
   v.cum.resize(world());
   int64_t acc = 0;
   for (int r = 0; r < world(); ++r) {
-    if (all_nrows[r] < 0) return kErrInvalidArg;
+    if (all_nrows[r] < 0) return fail(kErrInvalidArg);
     acc += all_nrows[r];
     v.cum[r] = acc;
   }
   // Sanity: our slot in the table must match what we were handed.
-  if (all_nrows[rank()] != nrows) return kErrShapeMismatch;
+  if (all_nrows[rank()] != nrows) return fail(kErrShapeMismatch);
 
   int64_t bytes = nrows * disp * itemsize;
   if (zero_fill || copy) {
     // Owned allocations go through the transport so a same-host fast path
     // can back them with shareable memory (see Transport::AllocShard).
     v.base = static_cast<char*>(transport_->AllocShard(name, bytes));
-    if (!v.base) return kErrNoMem;
+    if (!v.base) return fail(kErrNoMem);
     v.owned = true;
     if (zero_fill) {
       std::memset(v.base, 0, bytes);
@@ -208,6 +341,12 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
   if (it == vars_.end()) return kErrNotFound;
   VarInfo& v = it->second;
   if (row_offset + nrows > v.nrows) return kErrOutOfRange;
+  // Snapshot copy-on-publish: if any snapshot pins this shard at its
+  // CURRENT version and no kept copy exists yet, materialize one
+  // before the overwrite — still under the exclusive lock, so a
+  // concurrent snapshot read resolves to either the primary (old
+  // bytes) or the kept copy (same old bytes), never a torn mix.
+  MaybeKeepLocked(name, v);
   // CMA readers are not serialized by mu_; bounce them to the TCP path
   // (which is) for the duration of the overwrite.
   transport_->UnpublishVar(name);
@@ -219,7 +358,7 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
 }
 
 int Store::Get(const std::string& name, void* dst, int64_t start,
-               int64_t count) {
+               int64_t count, const std::string& as_tenant) {
   if (!dst || start < 0 || count <= 0) return kErrInvalidArg;
   VarInfo v;
   if (!GetVarInfo(name, &v)) return kErrNotFound;
@@ -234,31 +373,41 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
 
   int64_t offset = (start - shard_begin) * v.row_bytes();
   int64_t nbytes = count * v.row_bytes();
-  if (target == rank()) return ReadLocal(name, offset, nbytes, dst);
-  if (replication_ <= 1)
-    return RetryTransient(
+  int rc;
+  if (target == rank()) {
+    rc = ReadLocal(name, offset, nbytes, dst);
+  } else if (replication_ <= 1) {
+    rc = RetryTransient(
         [&]() {
           return transport_->Read(target, name, offset, nbytes, dst);
         },
         target);
-  // Replicated single-peer read: same failover contract as the batched
-  // paths (suspect short-circuit, ladder verdict -> replica chain,
-  // kErrPeerLost only when every holder is gone) but without the
-  // batched plan's per-call map — the healthy-primary common case is
-  // one direct retried read, exactly the R=1 fast path.
-  if (!PeerSuspected(target)) {
-    int rc = RetryTransient(
-        [&]() {
-          return transport_->Read(target, name, offset, nbytes, dst);
-        },
-        target);
-    if (rc != kErrPeerLost) return rc;
-    MarkPeerSuspected(target);
   } else {
-    failover_.suspect_skips.fetch_add(1, std::memory_order_relaxed);
+    // Replicated single-peer read: same failover contract as the
+    // batched paths (suspect short-circuit, ladder verdict -> replica
+    // chain, kErrPeerLost only when every holder is gone) but without
+    // the batched plan's per-call map — the healthy-primary common
+    // case is one direct retried read, exactly the R=1 fast path.
+    rc = kErrPeerLost;
+    bool via_replica = true;
+    if (!PeerSuspected(target)) {
+      rc = RetryTransient(
+          [&]() {
+            return transport_->Read(target, name, offset, nbytes, dst);
+          },
+          target);
+      via_replica = rc == kErrPeerLost;
+      if (via_replica) MarkPeerSuspected(target);
+    } else {
+      failover_.suspect_skips.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (via_replica) {
+      std::vector<ReadOp> ops(1, ReadOp{offset, nbytes, dst});
+      rc = ReadViaReplica(name, target, ops);
+    }
   }
-  std::vector<ReadOp> ops(1, ReadOp{offset, nbytes, dst});
-  return ReadViaReplica(name, target, ops);
+  if (rc == kOk) AccountTenantRead(name, nbytes, as_tenant);
+  return rc;
 }
 
 namespace {
@@ -276,7 +425,7 @@ struct Run {
 }  // namespace
 
 int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
-                    int64_t n) {
+                    int64_t n, const std::string& as_tenant) {
   if (!dst || !starts || n < 0) return kErrInvalidArg;
   if (n == 0) return kOk;
   VarInfo v;
@@ -436,7 +585,7 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
     // idempotent: every op rewrites its own dst/scratch span. Fatal
     // errors return here — the scratch block and any launched local
     // task are released on every path (unique_ptr + the Wait below).
-    int rc = RemoteRead(name, by_peer);
+    int rc = RemoteRead(name, by_peer, as_tenant);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
       return rc;
@@ -454,6 +603,7 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   }
   for (const Replica& rep : replicas)
     std::memcpy(out + rep.dst_slot * rb, out + rep.src_slot * rb, rb);
+  AccountTenantRead(name, n * rb, as_tenant);
   return kOk;
 }
 
@@ -602,7 +752,11 @@ void Store::RefreshMirrors(bool force) {
   {
     std::shared_lock<std::shared_mutex> lock(mu_);
     for (const auto& kv : vars_)
-      if (kv.first.empty() || kv.first[0] != '\x01')
+      // Primaries only: \x01 mirrors and \x03 snapshot/kept-version
+      // variables are never themselves mirrored (\x02 tenant shards
+      // are real data and replicate like any other).
+      if (kv.first.empty() ||
+          (kv.first[0] != '\x01' && kv.first[0] != '\x03'))
         prim.emplace_back(kv.first, kv.second);
   }
   for (const auto& nv : prim) {
@@ -695,8 +849,396 @@ void Store::FailoverCounters(int64_t out[16]) const {
   out[13] = health_.SuspectedCount();
 }
 
+// -- tenant quotas, shares, accounting ----------------------------------------
+
+int Store::SetTenantQuota(const std::string& tenant, int64_t max_bytes,
+                          int64_t max_vars) {
+  {
+    std::lock_guard<std::mutex> lock(tenants_mu_);
+    TenantState& t = tenants_[tenant];
+    t.quota_bytes = max_bytes;
+    t.quota_vars = max_vars;
+  }
+  if (tenant.empty()) track_default_tenant_.store(true);
+  return kOk;
+}
+
+int Store::SetTenantShare(const std::string& tenant, int share) {
+  if (share < 1) return kErrInvalidArg;
+  {
+    std::lock_guard<std::mutex> lock(tenants_mu_);
+    tenants_[tenant];  // the ledger knows every configured tenant
+  }
+  if (tenant.empty()) track_default_tenant_.store(true);
+  std::lock_guard<std::mutex> lock(async_mu_);
+  auto it = async_shares_.find(tenant);
+  if (it != async_shares_.end()) {
+    async_share_total_ -= it->second;
+    it->second = share;
+  } else {
+    async_shares_[tenant] = share;
+  }
+  async_share_total_ += share;
+  PumpAsyncLocked();  // a raised share may admit deferred reads now
+  return kOk;
+}
+
+int Store::TenantReserve(const std::string& tenant, int64_t bytes) {
+  std::lock_guard<std::mutex> lock(tenants_mu_);
+  TenantState& t = tenants_[tenant];
+  if ((t.quota_bytes >= 0 && t.bytes + bytes > t.quota_bytes) ||
+      (t.quota_vars >= 0 && t.vars + 1 > t.quota_vars)) {
+    ++t.quota_rejections;
+    return kErrQuota;
+  }
+  t.bytes += bytes;
+  ++t.vars;
+  return kOk;
+}
+
+void Store::TenantRelease(const std::string& tenant, int64_t bytes) {
+  std::lock_guard<std::mutex> lock(tenants_mu_);
+  auto it = tenants_.find(tenant);
+  if (it == tenants_.end()) return;
+  it->second.bytes -= bytes;
+  if (it->second.bytes < 0) it->second.bytes = 0;
+  if (it->second.vars > 0) --it->second.vars;
+}
+
+void Store::AccountTenantRead(const std::string& name, int64_t nbytes,
+                              const std::string& as_tenant) {
+  std::string tenant;
+  if (!as_tenant.empty()) {
+    // A named READING tenant always ledgers its own traffic — even of
+    // the shared default namespace (the headline attach() use case).
+    tenant = as_tenant;
+  } else {
+    if (name.empty() ||
+        (name[0] != '\x02' && name[0] != '\x03' &&
+         !track_default_tenant_.load(std::memory_order_relaxed)))
+      return;  // default path: zero locks
+    tenant = TenantOfVarName(name);
+    if (tenant.empty() &&
+        !track_default_tenant_.load(std::memory_order_relaxed))
+      return;
+  }
+  std::lock_guard<std::mutex> lock(tenants_mu_);
+  TenantState& t = tenants_[tenant];
+  t.read_bytes += nbytes;
+  ++t.reads;
+}
+
+void Store::AccountTenantServe(const std::string& name, int64_t nbytes) {
+  if (name.empty() ||
+      (name[0] != '\x01' && name[0] != '\x02' && name[0] != '\x03' &&
+       !track_default_tenant_.load(std::memory_order_relaxed)))
+    return;
+  const std::string tenant = TenantOfVarName(name);
+  if (tenant.empty() &&
+      !track_default_tenant_.load(std::memory_order_relaxed))
+    return;
+  std::lock_guard<std::mutex> lock(tenants_mu_);
+  TenantState& t = tenants_[tenant];
+  t.served_bytes += nbytes;
+  ++t.served_reads;
+}
+
+int Store::TenantNames(char* out, int cap) const {
+  if (!out || cap <= 0) return kErrInvalidArg;
+  std::vector<std::string> names;
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    for (const auto& kv : async_shares_) names.push_back(kv.first);
+  }
+  {
+    std::lock_guard<std::mutex> lock(tenants_mu_);
+    for (const auto& kv : tenants_)
+      if (std::find(names.begin(), names.end(), kv.first) == names.end())
+        names.push_back(kv.first);
+  }
+  std::sort(names.begin(), names.end());
+  // The DEFAULT tenant "" (sorted first) is encoded as a LEADING
+  // separator: a CSV of plain labels cannot otherwise represent it,
+  // and a configured default tenant's ledger row must stay visible to
+  // Python (metrics deltas, the planner's share split).
+  std::string csv;
+  size_t start = 0;
+  if (!names.empty() && names[0].empty()) {
+    csv = ",";
+    start = 1;
+  }
+  for (size_t i = start; i < names.size(); ++i) {
+    if (i > start) csv += ',';
+    csv += names[i];
+  }
+  const size_t n = csv.size() < static_cast<size_t>(cap - 1)
+                       ? csv.size()
+                       : static_cast<size_t>(cap - 1);
+  std::memcpy(out, csv.data(), n);
+  out[n] = '\0';
+  return static_cast<int>(n);
+}
+
+int Store::TenantCounters(const std::string& tenant,
+                          int64_t out[16]) const {
+  for (int i = 0; i < 16; ++i) out[i] = 0;
+  out[0] = out[1] = -1;  // quota gauges: unlimited by default
+  {
+    std::lock_guard<std::mutex> lock(tenants_mu_);
+    auto it = tenants_.find(tenant);
+    if (it != tenants_.end()) {
+      const TenantState& t = it->second;
+      out[0] = t.quota_bytes;
+      out[1] = t.quota_vars;
+      out[2] = t.bytes;
+      out[3] = t.vars;
+      out[4] = t.quota_rejections;
+      out[5] = t.read_bytes;
+      out[6] = t.reads;
+      out[7] = t.served_bytes;
+      out[8] = t.served_reads;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    auto a = async_tenant_admitted_.find(tenant);
+    if (a != async_tenant_admitted_.end()) out[9] = a->second;
+    auto d = async_tenant_deferred_.find(tenant);
+    if (d != async_tenant_deferred_.end()) out[10] = d->second;
+    // 0 = no share configured for this tenant (the gate then treats it
+    // as implicit weight 1 against the CONFIGURED total) — reporting
+    // the implicit 1 here would make "configured at weight 1" and
+    // "never configured" indistinguishable to the planner.
+    auto s = async_shares_.find(tenant);
+    out[12] = s != async_shares_.end() ? s->second : 0;
+  }
+  {
+    // Active snapshot pins this tenant's handles hold on THIS rank.
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    for (const auto& kv : snap_pins_)
+      if (kv.second.tenant == tenant) ++out[11];
+  }
+  return kOk;
+}
+
+// -- read-only snapshot epochs ------------------------------------------------
+
+std::string Store::SnapVarName(int64_t snap_id, const std::string& name) {
+  return std::string("\x03s\x03") + std::to_string(snap_id) + "\x03" +
+         name;
+}
+
+std::string Store::KeepVarName(int64_t seq, const std::string& name) {
+  return std::string("\x03k\x03") + std::to_string(seq) + "\x03" + name;
+}
+
+bool Store::ParseSnapName(const std::string& name, int64_t* id,
+                          std::string* base) {
+  if (name.compare(0, 3, "\x03s\x03") != 0) return false;
+  const size_t end = name.find('\x03', 3);
+  if (end == std::string::npos) return false;
+  char* e = nullptr;
+  const long long v = std::strtoll(name.c_str() + 3, &e, 10);
+  if (!e || *e != '\x03') return false;
+  *id = v;
+  *base = name.substr(end + 1);
+  return true;
+}
+
+std::map<std::string, VarInfo>::const_iterator Store::ResolveMetaLocked(
+    const std::string& name) const {
+  auto it = vars_.find(name);
+  if (it != vars_.end()) return it;
+  int64_t id;
+  std::string base;
+  if (!ParseSnapName(name, &id, &base)) return it;
+  return vars_.find(base);
+}
+
+std::map<std::string, VarInfo>::const_iterator Store::ResolveDataLocked(
+    const std::string& name) const {
+  auto it = vars_.find(name);
+  if (it != vars_.end()) return it;  // plain/mirror/keep: zero overhead
+  int64_t id;
+  std::string base;
+  if (!ParseSnapName(name, &id, &base)) return it;  // truly unknown
+  auto bit = vars_.find(base);
+  auto pit = snap_pins_.find(id);
+  if (pit == snap_pins_.end() || bit == vars_.end())
+    return bit;  // snapshot released (reader detached mid-read): the
+                 // primary serves — the kept copy may already be freed
+  auto vp = pit->second.pins.find(base);
+  if (vp == pit->second.pins.end())
+    return bit;  // var registered after the pin: current bytes
+  if (bit->second.update_seq == vp->second) return bit;  // unchanged
+  auto kit = vars_.find(KeepVarName(vp->second, base));
+  return kit != vars_.end() ? kit : bit;
+}
+
+void Store::MaybeKeepLocked(const std::string& name, const VarInfo& v) {
+  if (snap_pins_.empty()) return;  // default path: one empty() check
+  bool pinned = false;
+  for (const auto& kv : snap_pins_) {
+    auto p = kv.second.pins.find(name);
+    if (p != kv.second.pins.end() && p->second == v.update_seq) {
+      pinned = true;
+      break;
+    }
+  }
+  if (!pinned) return;
+  const std::string kname = KeepVarName(v.update_seq, name);
+  if (vars_.count(kname)) return;  // this version is already kept
+  const int64_t bytes = v.shard_bytes();
+  VarInfo k;
+  k.name = kname;
+  k.disp = v.disp;
+  k.itemsize = v.itemsize;
+  k.nrows = v.nrows;
+  k.cum.assign(1, v.nrows);  // local-only: kept copies are addressed by
+                             // byte offset, exactly like mirrors
+  k.base = static_cast<char*>(transport_->AllocShard(kname, bytes));
+  if (!k.base) return;  // no RAM for the copy: snapshot readers of this
+                        // shard degrade to current bytes, never a
+                        // failed Update
+  if (bytes > 0) std::memcpy(k.base, v.base, static_cast<size_t>(bytes));
+  k.owned = true;
+  vars_.emplace(kname, std::move(k));
+  ++kept_versions_;
+  kept_bytes_ += bytes;
+}
+
+void Store::FreeKeepsLocked(const std::string& name) {
+  for (auto it = vars_.begin(); it != vars_.end();) {
+    bool is_keep = it->first.compare(0, 3, "\x03k\x03") == 0;
+    if (is_keep) {
+      const size_t end = it->first.find('\x03', 3);
+      is_keep = end != std::string::npos &&
+                it->first.compare(end + 1, std::string::npos, name) == 0;
+    }
+    if (!is_keep) {
+      ++it;
+      continue;
+    }
+    if (it->second.owned) transport_->FreeShard(it->first, it->second.base);
+    kept_bytes_ -= it->second.shard_bytes();
+    --kept_versions_;
+    it = vars_.erase(it);
+  }
+}
+
+int Store::PinSnapshot(int64_t snap_id, const std::string& tenant) {
+  {
+    // The acquiring tenant becomes ledger-visible on every rank it
+    // pinned (the snapshot_pins gauge lives in its row). Sequential
+    // locks — tenants_mu_ stays a leaf, never nested under mu_.
+    std::lock_guard<std::mutex> tl(tenants_mu_);
+    tenants_[tenant];
+  }
+  std::unique_lock<std::shared_mutex> lock(mu_);
+  SnapPin sp;
+  sp.tenant = tenant;
+  for (const auto& kv : vars_) {
+    if (kv.first.empty() || kv.first[0] == '\x01' ||
+        kv.first[0] == '\x03')
+      continue;  // mirrors/keeps are never pinned themselves
+    // Pin the shared default namespace plus the ACQUIRING tenant's own
+    // variables only: another tenant's namespace is unreadable through
+    // this handle (cross-tenant reads are refused), so pinning it
+    // would only materialize kept copies of shards nobody can read —
+    // RAM cost scaling with unrelated tenants' update traffic.
+    if (kv.first[0] == '\x02' && TenantOfVarName(kv.first) != tenant)
+      continue;
+    sp.pins[kv.first] = kv.second.update_seq;
+  }
+  snap_pins_[snap_id] = std::move(sp);
+  return kOk;
+}
+
+int Store::UnpinSnapshot(int64_t snap_id) {
+  std::unique_lock<std::shared_mutex> lock(mu_);
+  auto it = snap_pins_.find(snap_id);
+  if (it == snap_pins_.end()) return kOk;  // idempotent: double release
+  const std::map<std::string, int64_t> pins = std::move(it->second.pins);
+  snap_pins_.erase(it);
+  for (const auto& pv : pins) {
+    bool still_pinned = false;
+    for (const auto& kv : snap_pins_) {
+      auto p = kv.second.pins.find(pv.first);
+      if (p != kv.second.pins.end() && p->second == pv.second) {
+        still_pinned = true;
+        break;
+      }
+    }
+    if (still_pinned) continue;
+    auto kit = vars_.find(KeepVarName(pv.second, pv.first));
+    if (kit == vars_.end()) continue;
+    // Freed exactly once, under the exclusive lock: an in-flight read
+    // serving from this copy holds the shared lock for its whole
+    // memcpy, so the free waits it out; the next read resolves to the
+    // primary.
+    if (kit->second.owned)
+      transport_->FreeShard(kit->first, kit->second.base);
+    kept_bytes_ -= kit->second.shard_bytes();
+    --kept_versions_;
+    vars_.erase(kit);
+  }
+  return kOk;
+}
+
+int64_t Store::SnapshotAcquire(const std::string& tenant) {
+  int64_t id;
+  {
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    id = (static_cast<int64_t>(rank()) << 32) | ++snap_counter_;
+  }
+  int rc = PinSnapshot(id, tenant);
+  if (rc != kOk) return rc;
+  for (int t = 0; t < world(); ++t) {
+    if (t == rank()) continue;
+    rc = transport_->SnapshotControl(t, id, /*pin=*/true, tenant);
+    if (rc != kOk) {
+      // All-or-nothing: a snapshot that silently missed an owner would
+      // serve torn epochs. Roll back what was placed.
+      for (int u = 0; u < t; ++u)
+        if (u != rank())
+          transport_->SnapshotControl(u, id, /*pin=*/false, tenant);
+      UnpinSnapshot(id);
+      return rc;
+    }
+  }
+  return id;
+}
+
+int Store::SnapshotRelease(int64_t snap_id) {
+  // Best effort on peers: a dead owner's pins died with it, and the
+  // release must still reclaim every local kept version.
+  for (int t = 0; t < world(); ++t)
+    if (t != rank())
+      transport_->SnapshotControl(t, snap_id, /*pin=*/false,
+                                  std::string());
+  return UnpinSnapshot(snap_id);
+}
+
+void Store::SnapshotCounters(int64_t out[4]) const {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  out[0] = static_cast<int64_t>(snap_pins_.size());
+  out[1] = kept_versions_;
+  out[2] = kept_bytes_;
+  out[3] = 0;
+}
+
 int Store::ReadViaReplica(const std::string& name, int owner,
                           const std::vector<ReadOp>& ops) {
+  // Snapshot-scoped (and kept-version) reads NEVER fail over: mirrors
+  // are registered for the base name only and hold the owner's CURRENT
+  // bytes, so serving one would silently violate the version pin.
+  // Stability over availability — the reader gets kErrPeerLost and can
+  // detach/re-attach for a fresh snapshot (README "Multi-tenant
+  // service", interaction with R>1).
+  if (!name.empty() && name[0] == '\x03') {
+    failover_.replica_giveups.fetch_add(1, std::memory_order_relaxed);
+    return kErrPeerLost;
+  }
   int64_t bytes = 0;
   for (const ReadOp& op : ops) bytes += op.nbytes;
   for (int k = 1; k < replication_; ++k) {
@@ -735,7 +1277,8 @@ int Store::ReadViaReplica(const std::string& name, int owner,
 }
 
 int Store::RemoteRead(const std::string& name,
-                      const std::map<int, std::vector<ReadOp>>& by_peer) {
+                      const std::map<int, std::vector<ReadOp>>& by_peer,
+                      const std::string& as_tenant) {
   if (by_peer.empty()) return kOk;
   if (replication_ <= 1) {
     // Exactly the pre-replication remote leg: one retried ReadVMulti,
@@ -749,7 +1292,8 @@ int Store::RemoteRead(const std::string& name,
     return RetryTransient(
         [&]() {
           return transport_->ReadVMulti(name, reqs.data(),
-                                        static_cast<int64_t>(reqs.size()));
+                                        static_cast<int64_t>(reqs.size()),
+                                        as_tenant);
         },
         target);
   }
@@ -777,7 +1321,8 @@ int Store::RemoteRead(const std::string& name,
     int rc = RetryTransient(
         [&]() {
           return transport_->ReadVMulti(name, go.data(),
-                                        static_cast<int64_t>(go.size()));
+                                        static_cast<int64_t>(go.size()),
+                                        as_tenant);
         },
         target);
     if (rc == kOk) return kOk;
@@ -815,16 +1360,46 @@ int Store::SetAsyncWidth(int n) {
   return kOk;
 }
 
+int Store::TenantLimitLocked(const std::string& tenant, int width) const {
+  if (async_shares_.empty()) return width;  // no QoS configured
+  auto it = async_shares_.find(tenant);
+  const int share = it == async_shares_.end() ? 1 : it->second;
+  const int64_t total = async_share_total_ > 0 ? async_share_total_ : 1;
+  int lim = static_cast<int>(
+      (static_cast<int64_t>(width) * share) / total);
+  if (lim < 1) lim = 1;  // every tenant always makes progress
+  return lim > width ? width : lim;
+}
+
 void Store::PumpAsyncLocked() {
-  while (async_pool_ && !async_deferred_.empty() &&
-         async_running_ < AsyncWidth()) {
+  // One forward scan admitting every deferred read whose tenant is
+  // under its share bound — not strictly FIFO across tenants: a
+  // backlogged tenant at its bound must not head-of-line-block the
+  // others (that is the whole point of the shares). A single pass is
+  // exact: admissions only RAISE running counts, so an entry skipped
+  // at its tenant's bound cannot become admissible later in the same
+  // pump — no restart-from-front needed (a deep throttled backlog at
+  // the head would otherwise make each pump O(backlog) per admission
+  // while holding async_mu_).
+  if (!async_pool_) return;
+  const int width = AsyncWidth();
+  for (auto it = async_deferred_.begin();
+       it != async_deferred_.end() && async_running_ < width;) {
+    if (async_tenant_running_[it->tenant] >=
+        TenantLimitLocked(it->tenant, width)) {
+      ++it;
+      continue;
+    }
     ++async_running_;
-    async_pool_->Submit(std::move(async_deferred_.front()));
-    async_deferred_.pop_front();
+    ++async_tenant_running_[it->tenant];
+    ++async_tenant_admitted_[it->tenant];
+    async_pool_->Submit(std::move(it->task));
+    it = async_deferred_.erase(it);
   }
 }
 
-int64_t Store::SubmitAsync(std::function<int()> fn) {
+int64_t Store::SubmitAsync(const std::string& tenant,
+                           std::function<int()> fn) {
   auto st = std::make_shared<AsyncState>();
   int64_t ticket;
   {
@@ -843,7 +1418,7 @@ int64_t Store::SubmitAsync(std::function<int()> fn) {
     }
     ticket = next_ticket_++;
     async_[ticket] = st;
-    auto task = [this, fn = std::move(fn), st]() {
+    auto task = [this, tenant, fn = std::move(fn), st]() {
       int rc = fn();
       {
         std::lock_guard<std::mutex> lock(st->mu);
@@ -857,25 +1432,37 @@ int64_t Store::SubmitAsync(std::function<int()> fn) {
       // and callers must not race teardown with new issues).
       std::lock_guard<std::mutex> lock(async_mu_);
       --async_running_;
+      auto rit = async_tenant_running_.find(tenant);
+      if (rit != async_tenant_running_.end() && rit->second > 0)
+        --rit->second;
       PumpAsyncLocked();
     };
-    if (async_running_ < AsyncWidth()) {
+    if (async_running_ < AsyncWidth() &&
+        async_tenant_running_[tenant] <
+            TenantLimitLocked(tenant, AsyncWidth())) {
       ++async_running_;
+      ++async_tenant_running_[tenant];
+      ++async_tenant_admitted_[tenant];
       async_pool_->Submit(std::move(task));
     } else {
-      async_deferred_.push_back(std::move(task));
+      ++async_tenant_deferred_[tenant];
+      async_deferred_.push_back(DeferredRead{tenant, std::move(task)});
     }
   }
   return ticket;
 }
 
 int64_t Store::GetBatchAsync(const std::string& name, void* dst,
-                             const int64_t* starts, int64_t n) {
+                             const int64_t* starts, int64_t n,
+                             const std::string& as_tenant) {
   if (!dst || !starts || n < 0) return kErrInvalidArg;
   std::vector<int64_t> idx(starts, starts + n);
-  return SubmitAsync([this, name, dst, idx = std::move(idx)]() {
+  const std::string tenant =
+      as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
+  return SubmitAsync(tenant,
+                     [this, name, dst, tenant, idx = std::move(idx)]() {
     return GetBatch(name, dst, idx.data(),
-                    static_cast<int64_t>(idx.size()));
+                    static_cast<int64_t>(idx.size()), tenant);
   });
 }
 
@@ -883,17 +1470,22 @@ int64_t Store::ReadRunsAsync(const std::string& name, void* dst,
                              const int64_t* targets,
                              const int64_t* src_off,
                              const int64_t* dst_off,
-                             const int64_t* nbytes, int64_t nruns) {
+                             const int64_t* nbytes, int64_t nruns,
+                             const std::string& as_tenant) {
   if (!dst || !targets || !src_off || !dst_off || !nbytes || nruns < 0)
     return kErrInvalidArg;
   std::vector<int64_t> t(targets, targets + nruns);
   std::vector<int64_t> so(src_off, src_off + nruns);
   std::vector<int64_t> dof(dst_off, dst_off + nruns);
   std::vector<int64_t> nb(nbytes, nbytes + nruns);
-  return SubmitAsync([this, name, dst, t = std::move(t),
+  const std::string tenant =
+      as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
+  return SubmitAsync(tenant,
+                     [this, name, dst, tenant, t = std::move(t),
                       so = std::move(so), dof = std::move(dof),
                       nb = std::move(nb)]() {
-    return ReadRuns(name, static_cast<char*>(dst), t, so, dof, nb);
+    return ReadRuns(name, static_cast<char*>(dst), t, so, dof, nb,
+                    tenant);
   });
 }
 
@@ -901,7 +1493,8 @@ int Store::ReadRuns(const std::string& name, char* dst,
                     const std::vector<int64_t>& targets,
                     const std::vector<int64_t>& src_off,
                     const std::vector<int64_t>& dst_off,
-                    const std::vector<int64_t>& nbytes) {
+                    const std::vector<int64_t>& nbytes,
+                    const std::string& as_tenant) {
   VarInfo v;
   if (!GetVarInfo(name, &v)) return kErrNotFound;
   const int64_t nruns = static_cast<int64_t>(targets.size());
@@ -940,13 +1533,18 @@ int Store::ReadRuns(const std::string& name, char* dst,
     }
   }
   if (!by_peer.empty()) {
-    int rc = RemoteRead(name, by_peer);
+    int rc = RemoteRead(name, by_peer, as_tenant);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
       return rc;
     }
   }
   if (local_group) local_group->Wait();
+  if (local_rc == kOk) {
+    int64_t total = 0;
+    for (int64_t nb : nbytes) total += nb;
+    AccountTenantRead(name, total, as_tenant);
+  }
   return local_rc;
 }
 
@@ -1055,34 +1653,62 @@ int Store::Rebind(const std::string& name, void* base) {
 }
 
 int Store::FreeVar(const std::string& name) {
-  std::unique_lock<std::shared_mutex> lock(mu_);
-  auto it = vars_.find(name);
-  if (it == vars_.end()) return kErrNotFound;
-  transport_->UnpublishVar(name);
-  if (it->second.owned) transport_->FreeShard(name, it->second.base);
-  vars_.erase(it);
-  // Drop this rank's mirrors of the freed variable too (free() is
-  // collective at the Python layer, so every holder runs this).
-  if (replication_ > 1) {
-    for (int o = 0; o < world(); ++o) {
-      auto mit = vars_.find(MirrorVarName(name, o));
-      if (mit == vars_.end()) continue;
-      transport_->UnpublishVar(mit->first);
-      if (mit->second.owned)
-        transport_->FreeShard(mit->first, mit->second.base);
-      vars_.erase(mit);
+  int64_t reserved_bytes = -1;
+  {
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    auto it = vars_.find(name);
+    if (it == vars_.end()) return kErrNotFound;
+    reserved_bytes = it->second.quota_reserved;
+    transport_->UnpublishVar(name);
+    if (it->second.owned) transport_->FreeShard(name, it->second.base);
+    vars_.erase(it);
+    // Kept snapshot versions of the variable die with it (their pins
+    // now resolve to nothing; UnpinSnapshot tolerates the absence).
+    FreeKeepsLocked(name);
+    // And so do the PINS themselves: a later add() under the same name
+    // restarts at update_seq 0, which would ALIAS a stale pin and
+    // serve the new generation's bytes as "pinned". Without the pin a
+    // snapshot read degrades to kErrNotFound while freed, then to
+    // current bytes after the re-add — the registered-after-the-pin
+    // semantics.
+    for (auto& kv : snap_pins_) kv.second.pins.erase(name);
+    // Drop this rank's mirrors of the freed variable too (free() is
+    // collective at the Python layer, so every holder runs this).
+    if (replication_ > 1) {
+      for (int o = 0; o < world(); ++o) {
+        auto mit = vars_.find(MirrorVarName(name, o));
+        if (mit == vars_.end()) continue;
+        transport_->UnpublishVar(mit->first);
+        if (mit->second.owned)
+          transport_->FreeShard(mit->first, mit->second.base);
+        vars_.erase(mit);
+      }
     }
   }
+  // Quota returned AFTER the registry lock drops (leaf-lock discipline);
+  // exactly what registration reserved, never a post-hoc recomputation.
+  if (reserved_bytes >= 0)
+    TenantRelease(TenantOfVarName(name), reserved_bytes);
   return kOk;
 }
 
 int Store::FreeAll() {
-  std::unique_lock<std::shared_mutex> lock(mu_);
-  for (auto& kv : vars_) {
-    transport_->UnpublishVar(kv.first);
-    if (kv.second.owned) transport_->FreeShard(kv.first, kv.second.base);
+  std::vector<std::pair<std::string, int64_t>> released;
+  {
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    for (auto& kv : vars_) {
+      transport_->UnpublishVar(kv.first);
+      if (kv.second.owned) transport_->FreeShard(kv.first, kv.second.base);
+      if (kv.second.quota_reserved >= 0)
+        released.emplace_back(TenantOfVarName(kv.first),
+                              kv.second.quota_reserved);
+    }
+    vars_.clear();
+    snap_pins_.clear();
+    kept_versions_ = 0;
+    kept_bytes_ = 0;
   }
-  vars_.clear();
+  for (const auto& r : released) TenantRelease(r.first, r.second);
   return kOk;
 }
 
@@ -1107,7 +1733,7 @@ static inline bool RangeBad(int64_t offset, int64_t nbytes, int64_t sb) {
 int Store::ReadLocal(const std::string& name, int64_t offset,
                      int64_t nbytes, void* dst) const {
   std::shared_lock<std::shared_mutex> lock(mu_);
-  auto it = vars_.find(name);
+  auto it = ResolveDataLocked(name);
   if (it == vars_.end()) return kErrNotFound;
   const VarInfo& v = it->second;
   if (RangeBad(offset, nbytes, v.shard_bytes())) return kErrOutOfRange;
@@ -1118,7 +1744,7 @@ int Store::ReadLocal(const std::string& name, int64_t offset,
 int Store::ReadLocalV(const std::string& name, const ReadOp* ops,
                       int64_t n) const {
   std::shared_lock<std::shared_mutex> lock(mu_);
-  auto it = vars_.find(name);
+  auto it = ResolveDataLocked(name);
   if (it == vars_.end()) return kErrNotFound;
   const VarInfo& v = it->second;
   const int64_t sb = v.shard_bytes();
@@ -1134,14 +1760,14 @@ int Store::WithShard(const std::string& name,
                      const std::function<int(const char*, int64_t)>& fn)
     const {
   std::shared_lock<std::shared_mutex> lock(mu_);
-  auto it = vars_.find(name);
+  auto it = ResolveDataLocked(name);
   if (it == vars_.end()) return kErrNotFound;
   return fn(it->second.base, it->second.shard_bytes());
 }
 
 bool Store::GetVarInfo(const std::string& name, VarInfo* out) const {
   std::shared_lock<std::shared_mutex> lock(mu_);
-  auto it = vars_.find(name);
+  auto it = ResolveMetaLocked(name);
   if (it == vars_.end()) return false;
   *out = it->second;  // copies metadata; base pointer stays valid until free
   return true;

@@ -49,12 +49,36 @@ enum ErrorCode : int {
   kErrExists = -7,       // variable already registered
   kErrNoMem = -8,        // allocation failure
   kErrShapeMismatch = -9,// disp/itemsize disagree across ranks
-  kErrPeerLost = -10     // transient-retry budget exhausted against one
+  kErrPeerLost = -10,    // transient-retry budget exhausted against one
                          // peer: the bounded "owner is gone" signal
                          // (fatal — invoke elastic.recover, do not retry)
+  kErrQuota = -11        // tenant byte/var budget exhausted at
+                         // registration: admission refused. Classified
+                         // DISTINCTLY from kErrPeerLost — nothing died,
+                         // the tenant is over budget (free vars or raise
+                         // the quota; retrying is pointless)
 };
 
 const char* ErrorString(int code);
+
+// -- tenant namespaces --------------------------------------------------------
+//
+// A multi-tenant store scopes every non-default tenant's variables as
+// "\x02<tenant>\x02<name>" in the ONE native registry, so every
+// existing serving leg (local memcpy, CMA, TCP iovec streaming,
+// replication mirrors) works on tenant variables unchanged. The default
+// tenant "" uses the bare name — the entire pre-tenancy tree is byte-
+// and error-code-identical, the same discipline as DDSTORE_REPLICATION=1.
+// \x02 cannot appear in a user name that came through the Python layer
+// (control characters are rejected there), so scoped names can never
+// collide with plain ones, with \x01 mirrors, or with \x03 snapshot
+// names.
+
+// The tenant a registry name belongs to ("" = default). Sees through
+// the \x01 mirror and \x03 snapshot/kept-version wrappers so serve-side
+// accounting attributes mirror pulls and snapshot reads to the tenant
+// that owns the underlying data.
+std::string TenantOfVarName(const std::string& name);
 
 struct VarInfo {
   std::string name;
@@ -74,6 +98,12 @@ struct VarInfo {
   // re-pull).
   int64_t update_seq = 0;
   int64_t mirror_src_seq = -1;
+  // Bytes reserved against the owning tenant's quota at registration
+  // (-1 = none: the ledger was not tracking this namespace at add
+  // time). The free paths release exactly this amount, so configuring
+  // the default tenant between add and free never releases budget
+  // that was never reserved.
+  int64_t quota_reserved = -1;
 
   int64_t row_bytes() const { return disp * itemsize; }
   int64_t total_rows() const { return cum.empty() ? 0 : cum.back(); }
@@ -177,9 +207,14 @@ class Transport {
   // Batched multi-peer read: every entry's ops go to its target, with
   // whatever concurrency the transport can supply (the TCP transport runs
   // them on a persistent worker pool). Default: sequential ReadV per peer,
-  // stopping at the first error.
+  // stopping at the first error. `as_tenant` names the READING tenant
+  // for QoS lane budgets ("" = derive from the variable name) — a named
+  // tenant streaming the shared default namespace must burn its OWN
+  // lane budget, exactly like the async admission gate.
   virtual int ReadVMulti(const std::string& name, const PeerReadV* reqs,
-                         int64_t nreqs) {
+                         int64_t nreqs,
+                         const std::string& as_tenant = std::string()) {
+    (void)as_tenant;  // lane budgets are a TCP-transport concern
     for (int64_t i = 0; i < nreqs; ++i) {
       int rc = ReadV(reqs[i].target, name, reqs[i].ops, reqs[i].n);
       if (rc != 0) return rc;
@@ -252,6 +287,20 @@ class Transport {
     return -1;
   }
 
+  // Snapshot-epoch control op: ask `target`'s store to pin (or release)
+  // snapshot `snap_id` (see Store::SnapshotAcquire). Control plane like
+  // Ping/ReadVarSeq — never a data lane, never a fault-injector draw.
+  // `tenant` is the acquiring handle's tenant label (per-tenant
+  // snapshot-pin accounting on the owner). Default: unsupported.
+  virtual int SnapshotControl(int target, int64_t snap_id, bool pin,
+                              const std::string& tenant) {
+    (void)target;
+    (void)snap_id;
+    (void)pin;
+    (void)tenant;
+    return kErrTransport;
+  }
+
   // Install the store's suspect oracle: transports with an internal
   // retry layer consult it between attempts so a ladder against a
   // detector-declared-dead peer aborts in O(heartbeat), not
@@ -309,7 +358,8 @@ class Store {
   // lie within a single rank's shard (kept from the reference,
   // ddstore.hpp:210-214: it keeps every read single-peer; use GetBatch for
   // scattered indices). Local reads short-circuit to memcpy.
-  int Get(const std::string& name, void* dst, int64_t start, int64_t count);
+  int Get(const std::string& name, void* dst, int64_t start, int64_t count,
+          const std::string& as_tenant = std::string());
 
   // Read n single rows with global indices starts[0..n) into dst (densely
   // packed, n*row_bytes). The scatter-read planner sorts the indices,
@@ -324,8 +374,11 @@ class Store {
   // request permits. This is the hot-path fix for the reference's
   // one-blocking-read-per-sample pattern (ddstore.hpp:197-248 called per
   // sample per batch).
+  // `as_tenant` names the READING tenant for the per-tenant read
+  // ledger and QoS lane budget ("" = derive from the variable name);
+  // see GetBatchAsync for why the two differ.
   int GetBatch(const std::string& name, void* dst, const int64_t* starts,
-               int64_t n);
+               int64_t n, const std::string& as_tenant = std::string());
 
   // Snapshot of the cumulative scatter-read planner statistics.
   PlanStats plan_stats() const;
@@ -363,8 +416,15 @@ class Store {
   // teardown barrier loader cancellation needs.
 
   // Returns a positive ticket, or a negative ErrorCode on invalid args.
+  // `as_tenant` names the READING handle for QoS admission and the
+  // admitted/deferred ledger ("" = derive from the variable name, the
+  // pre-tenancy behavior). The two differ exactly when a named tenant
+  // reads the shared default namespace — the headline attach() use
+  // case — where deriving from the name would gate the eval reader
+  // under the default tenant's share instead of its own.
   int64_t GetBatchAsync(const std::string& name, void* dst,
-                        const int64_t* starts, int64_t n);
+                        const int64_t* starts, int64_t n,
+                        const std::string& as_tenant = std::string());
 
   // Async vectored run read — the readahead window fast path. The
   // caller (the Python window planner) has already sorted,
@@ -374,11 +434,13 @@ class Store {
   // runs, the planner pass otherwise rivals the copy time). Run i
   // reads nbytes[i] at byte offset src_off[i] of targets[i]'s shard
   // into dst + dst_off[i]. Same ticket/waiting contract as
-  // GetBatchAsync; all four arrays are copied at issue time.
+  // GetBatchAsync (including `as_tenant`); all four arrays are copied
+  // at issue time.
   int64_t ReadRunsAsync(const std::string& name, void* dst,
                         const int64_t* targets, const int64_t* src_off,
                         const int64_t* dst_off, const int64_t* nbytes,
-                        int64_t nruns);
+                        int64_t nruns,
+                        const std::string& as_tenant = std::string());
   // 1 = done ok; 0 = still in flight after `timeout_ms` (0 polls,
   // negative waits forever); <0 = the completed read's error, or
   // kErrInvalidArg for an unknown/released ticket. `done_mono_s`, when
@@ -466,6 +528,77 @@ class Store {
   // hb_failures, hb_suspects_raised, hb_active, suspected_now].
   void FailoverCounters(int64_t out[16]) const;
 
+  // -- tenant quotas, shares, accounting ----------------------------------
+  //
+  // Per-tenant admission control: a byte/var budget checked atomically
+  // at add/init registration (kErrQuota on exhaustion — a distinct,
+  // non-fatal class), a weighted async-admission share so one tenant's
+  // readahead cannot starve another's scatter reads (built on the PR 6
+  // admission gate), and a per-tenant ledger (bytes, reads, serves,
+  // admissions, deferrals, rejections, snapshot pins) surfaced through
+  // summary()["tenants"]. All of it is inert — zero locks, zero
+  // branches beyond one first-byte check — until a tenant is
+  // configured or a scoped name appears.
+
+  // Byte/var budget for `tenant` (< 0 = unlimited). Checked-and-reserved
+  // atomically at registration; Free returns the budget.
+  int SetTenantQuota(const std::string& tenant, int64_t max_bytes,
+                     int64_t max_vars);
+  // Async-admission weight (>= 1). With any share configured, tenant t
+  // may have at most max(1, width * share_t / total_shares) async
+  // batched reads RUNNING at once; excess defers (never rejected) and
+  // admits as slots free. No shares configured = no per-tenant gate,
+  // exactly the pre-tenancy admission.
+  int SetTenantShare(const std::string& tenant, int share);
+  // CSV of every tenant the store has seen (config or traffic).
+  int TenantNames(char* out, int cap) const;
+  // Ledger snapshot for one tenant. Layout (keep in sync with
+  // binding.py TENANT_STAT_KEYS): [quota_bytes, quota_vars, bytes,
+  // vars, quota_rejections, read_bytes, reads, served_bytes,
+  // served_reads, async_admitted, async_deferred, snapshot_pins,
+  // share]. quota_*/bytes/vars/share/snapshot_pins are gauges; share
+  // reports 0 when no share was configured for the tenant (the gate
+  // then grants it implicit weight 1 against the configured total).
+  int TenantCounters(const std::string& tenant, int64_t out[16]) const;
+  // Serve-side accounting hook (the transport's serving loop calls it
+  // after streaming a response): attributes `nbytes` of served reads
+  // to the tenant that owns `name`. Cheap no-op for unscoped names
+  // unless the default tenant was explicitly configured.
+  void AccountTenantServe(const std::string& name, int64_t nbytes);
+
+  // -- read-only snapshot epochs ------------------------------------------
+  //
+  // A reader pins the CURRENT content version of every shard
+  // (SnapshotAcquire: local pin + a control op to every peer) and then
+  // reads through snapshot-scoped names ("\x03s\x03<id>\x03<name>",
+  // built by the Python layer). The paper's `update` path becomes a
+  // safe ONLINE write API: Update() on a var whose current version a
+  // snapshot pins first copies the old shard bytes into a hidden
+  // kept-version variable ("\x03k\x03<seq>\x03<name>",
+  // copy-on-publish, updated shards only), then overwrites — the
+  // owner resolves each snapshot read to the primary (version
+  // unchanged) or the kept copy under ONE registry-lock acquisition,
+  // so a snapshot reader is byte-stable across a concurrent writer's
+  // update + epoch fence. The kept copy is reclaimed when the last
+  // snapshot pinning that version releases.
+
+  // Pin the store-wide current versions; returns a positive snapshot
+  // id, or a negative ErrorCode (a peer that cannot be pinned fails
+  // the acquire and already-placed pins are rolled back). `tenant`
+  // labels the acquiring handle for per-tenant pin accounting.
+  int64_t SnapshotAcquire(const std::string& tenant);
+  // Release a snapshot everywhere; kept versions whose last pin this
+  // was are freed (peers best-effort: a dead peer's pins die with it).
+  int SnapshotRelease(int64_t snap_id);
+  // Owner-side halves (also the transport's control-op entry points).
+  int PinSnapshot(int64_t snap_id, const std::string& tenant);
+  int UnpinSnapshot(int64_t snap_id);
+  // [active_snapshots, kept_versions, kept_bytes, 0] on THIS rank.
+  void SnapshotCounters(int64_t out[4]) const;
+  // Snapshot-scoped registry name (exposed for the Python layer/tests).
+  static std::string SnapVarName(int64_t snap_id, const std::string& name);
+  static std::string KeepVarName(int64_t seq, const std::string& name);
+
   // Metadata query: total rows across all ranks (reference `query`,
   // src/ddstore.cxx:46-49) plus shape info.
   int Query(const std::string& name, int64_t* total_rows, int64_t* disp,
@@ -549,7 +682,8 @@ class Store {
   // suspected and replans ITS ops onto the replica set — iterating
   // until everything landed or a row's whole replica set is gone.
   int RemoteRead(const std::string& name,
-                 const std::map<int, std::vector<ReadOp>>& by_peer);
+                 const std::map<int, std::vector<ReadOp>>& by_peer,
+                 const std::string& as_tenant = std::string());
   // Serve `owner`'s ops from its replica chain (local mirror memcpy or
   // a remote read of the holder's mirror variable). kErrPeerLost when
   // every holder is gone or mirrorless.
@@ -565,8 +699,76 @@ class Store {
   // The peer the most recent retry-layer failure named (-1 unknown).
   int LastFailedPeer() const;
 
+  // Pin-aware registry resolution, the single point every read-serving
+  // leg (ReadLocal/ReadLocalV/WithShard — local memcpy, CMA fallback,
+  // TCP streaming alike) goes through: a snapshot-scoped name resolves
+  // to the primary while its pinned version is current, else to the
+  // kept copy — atomically under the ONE lock acquisition the caller
+  // already holds, so a concurrent Update can never tear a snapshot
+  // read. Plain names resolve to themselves at zero extra cost.
+  std::map<std::string, VarInfo>::const_iterator ResolveDataLocked(
+      const std::string& name) const DDS_REQUIRES(mu_);
+  // Metadata resolution: a snapshot name's SHAPE (cum table, row bytes)
+  // is always the primary's — versions never change geometry — so the
+  // reader-side batch planner partitions snapshot reads by owner
+  // exactly like primary reads.
+  std::map<std::string, VarInfo>::const_iterator ResolveMetaLocked(
+      const std::string& name) const DDS_REQUIRES(mu_);
+  static bool ParseSnapName(const std::string& name, int64_t* id,
+                            std::string* base);
+  // Copy-on-publish: called by Update under the exclusive lock BEFORE
+  // overwriting — if any snapshot pins this var at its current
+  // version and no kept copy exists yet, materialize one.
+  void MaybeKeepLocked(const std::string& name, const VarInfo& v)
+      DDS_REQUIRES(mu_);
+  // Drop every kept version of `name` (FreeVar's snapshot half).
+  void FreeKeepsLocked(const std::string& name) DDS_REQUIRES(mu_);
+
+  // Atomic quota check-and-reserve / release (leaf lock — never nested
+  // under mu_: AddInternal reserves BEFORE registration and rolls back
+  // on failure).
+  int TenantReserve(const std::string& tenant, int64_t bytes);
+  void TenantRelease(const std::string& tenant, int64_t bytes);
+  void AccountTenantRead(const std::string& name, int64_t nbytes,
+                         const std::string& as_tenant = std::string());
+  // Per-tenant admission bound at the given width; no shares
+  // configured = the full width (pre-tenancy behavior).
+  int TenantLimitLocked(const std::string& tenant, int width) const
+      DDS_REQUIRES(async_mu_);
+
   int replication_ = 1;    // env, clamped to [1, world] at construction
   FailoverStats failover_;
+
+  // Per-tenant ledger + quotas. Leaf mutex by design (see
+  // TenantReserve); the hot-path guard is the first-byte check in
+  // TenantOfVarName callers, so the default tree takes no lock here.
+  struct TenantState {
+    int64_t quota_bytes = -1;  // < 0 = unlimited
+    int64_t quota_vars = -1;
+    int64_t bytes = 0;         // registered primary shard bytes
+    int64_t vars = 0;
+    int64_t quota_rejections = 0;
+    int64_t read_bytes = 0;    // client-side delivered
+    int64_t reads = 0;
+    int64_t served_bytes = 0;  // server-side (wire) traffic
+    int64_t served_reads = 0;
+  };
+  mutable std::mutex tenants_mu_ DDS_NO_BLOCKING;
+  std::map<std::string, TenantState> tenants_ DDS_GUARDED_BY(tenants_mu_);
+  // True once the DEFAULT tenant "" was explicitly configured — only
+  // then is unscoped traffic accounted (zero-overhead default path).
+  std::atomic<bool> track_default_tenant_{false};
+
+  // Snapshot-epoch state, guarded by the registry lock (pin/unpin and
+  // kept-version lifecycle are registry mutations).
+  struct SnapPin {
+    std::string tenant;                   // acquiring handle's label
+    std::map<std::string, int64_t> pins;  // var -> pinned update_seq
+  };
+  std::map<int64_t, SnapPin> snap_pins_ DDS_GUARDED_BY(mu_);
+  int64_t snap_counter_ DDS_GUARDED_BY(mu_) = 0;
+  int64_t kept_versions_ DDS_GUARDED_BY(mu_) = 0;
+  int64_t kept_bytes_ DDS_GUARDED_BY(mu_) = 0;
 
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
@@ -608,9 +810,11 @@ class Store {
                const std::vector<int64_t>& targets,
                const std::vector<int64_t>& src_off,
                const std::vector<int64_t>& dst_off,
-               const std::vector<int64_t>& nbytes);
-  // Shared issue half of GetBatchAsync/ReadRunsAsync.
-  int64_t SubmitAsync(std::function<int()> fn);
+               const std::vector<int64_t>& nbytes,
+               const std::string& as_tenant = std::string());
+  // Shared issue half of GetBatchAsync/ReadRunsAsync. `tenant` rides
+  // the admission gate (QoS shares) and the per-tenant ledger.
+  int64_t SubmitAsync(const std::string& tenant, std::function<int()> fn);
   // Admit the next deferred async reads while running < width. Caller
   // holds async_mu_.
   void PumpAsyncLocked() DDS_REQUIRES(async_mu_);
@@ -632,8 +836,23 @@ class Store {
   int async_default_ = 2;  // env/ladder default, resolved at construction
   // reads admitted to the pool
   int async_running_ DDS_GUARDED_BY(async_mu_) = 0;
-  // awaiting a slot
-  std::deque<std::function<void()>> async_deferred_
+  // awaiting a slot (tenant-tagged: the pump admits the first entry
+  // whose tenant is under ITS share bound, so a backlogged tenant
+  // cannot head-of-line-block the others)
+  struct DeferredRead {
+    std::string tenant;
+    std::function<void()> task;
+  };
+  std::deque<DeferredRead> async_deferred_ DDS_GUARDED_BY(async_mu_);
+  // Per-tenant admission state (QoS shares). Empty share map = no
+  // per-tenant gate — the exact pre-tenancy admission.
+  std::map<std::string, int> async_shares_ DDS_GUARDED_BY(async_mu_);
+  int64_t async_share_total_ DDS_GUARDED_BY(async_mu_) = 0;
+  std::map<std::string, int> async_tenant_running_
+      DDS_GUARDED_BY(async_mu_);
+  std::map<std::string, int64_t> async_tenant_admitted_
+      DDS_GUARDED_BY(async_mu_);
+  std::map<std::string, int64_t> async_tenant_deferred_
       DDS_GUARDED_BY(async_mu_);
 
   // Heartbeat failure detector + suspect registry. Declared LAST so it

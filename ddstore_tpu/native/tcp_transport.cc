@@ -27,12 +27,16 @@ constexpr uint32_t kMagic = 0xDD57EAD0;
 enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
                      kOpCmaInfo = 4,
                      // Control-plane ops: heartbeat probe (bare ok
-                     // WireResp) and shard content-version query (seq
-                     // in resp.nbytes). Deliberately OUTSIDE the fault
-                     // injector's op gate below — control frames must
-                     // not consume data-path draws, or seeded chaos
-                     // schedules would shift with the detector on.
-                     kOpPing = 5, kOpVarSeq = 6 };
+                     // WireResp), shard content-version query (seq
+                     // in resp.nbytes), and snapshot-epoch pin/release
+                     // (snapshot id in req.tag; name carries the
+                     // acquiring tenant label). Deliberately OUTSIDE
+                     // the fault injector's op gate below — control
+                     // frames must not consume data-path draws, or
+                     // seeded chaos schedules would shift with the
+                     // detector (or a snapshot reader) on.
+                     kOpPing = 5, kOpVarSeq = 6,
+                     kOpSnapPin = 7, kOpSnapUnpin = 8 };
 
 #pragma pack(push, 1)
 struct WireReq {
@@ -580,6 +584,7 @@ int TcpTransport::UpdatePeer(int target, const std::string& host_csv,
     // pid and fail fast); it is freed at transport teardown.
     std::lock_guard<std::mutex> lock(p.cma_mu);
     p.cma_state = 0;
+    ++p.cma_gen;  // invalidates any probe in flight (see EnsureCmaPeer)
     if (p.cma) p.cma_retired.push_back(std::move(p.cma));
   }
   {
@@ -759,6 +764,19 @@ void TcpTransport::HandleConnection(int fd) {
       if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
       continue;
     }
+    if (req.op == kOpSnapPin || req.op == kOpSnapUnpin) {
+      // Snapshot-epoch pin/release (req.tag = snapshot id, name = the
+      // acquiring tenant label). Owner-side registry mutation; the
+      // response is just the ack the acquirer's all-or-nothing
+      // contract needs.
+      int rc = kErrNotFound;
+      if (store_)
+        rc = req.op == kOpSnapPin ? store_->PinSnapshot(req.tag, name)
+                                  : store_->UnpinSnapshot(req.tag);
+      WireResp resp{rc, 0, 0};
+      if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+      continue;
+    }
     if (req.op == kOpCmaInfo) {
       // Same-host discovery: "<pid> <starttime> <host-token>
       // <segment-name|->". The token (boot_id + pid-namespace) gates
@@ -861,7 +879,13 @@ void TcpTransport::HandleConnection(int fd) {
               return kOk;
             });
         if (conn_dead) return;
-        if (rc == kOk) continue;  // header + payload already sent
+        if (rc == kOk) {  // header + payload already sent
+          // Tenant serve ledger: the op frame's variable name IS the
+          // tenant tag (scoped registration makes it so); a no-op
+          // first-byte check for unscoped names.
+          store_->AccountTenantServe(name, total);
+          continue;
+        }
         resp.status = rc;         // kErrNotFound / kErrOutOfRange
       }
       resp.nbytes = 0;
@@ -890,7 +914,10 @@ void TcpTransport::HandleConnection(int fd) {
             return kOk;
           });
       if (conn_dead) return;
-      if (rc == kOk) continue;  // header + payload already sent
+      if (rc == kOk) {  // header + payload already sent
+        store_->AccountTenantServe(name, req.nbytes);
+        continue;
+      }
       resp.status = rc;
     }
     resp.nbytes = 0;
@@ -1078,7 +1105,8 @@ int TcpTransport::EnsureControlConn(PingConn& pc, long timeout_ms) {
 
 bool TcpTransport::ControlRoundTrip(PingConn& pc, uint32_t op,
                                     const std::string& name,
-                                    long timeout_ms, void* resp) {
+                                    long timeout_ms, void* resp,
+                                    int64_t tag) {
   auto fail = [&]() {
     if (pc.fd >= 0) {
       ::close(pc.fd);
@@ -1093,7 +1121,7 @@ bool TcpTransport::ControlRoundTrip(PingConn& pc, uint32_t op,
   ::setsockopt(pc.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   ::setsockopt(pc.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   WireReq req{kMagic, op, rank_,
-              static_cast<uint32_t>(name.size()), 0, 0, 0};
+              static_cast<uint32_t>(name.size()), 0, 0, tag};
   if (FullSend(pc.fd, &req, sizeof(req)) != 0) return fail();
   if (!name.empty() &&
       FullSend(pc.fd, name.data(), name.size()) != 0)
@@ -1131,6 +1159,54 @@ int64_t TcpTransport::ReadVarSeq(int target, const std::string& name) {
                         &resp))
     return -1;
   return resp.nbytes;
+}
+
+int TcpTransport::SnapshotControl(int target, int64_t snap_id, bool pin,
+                                  const std::string& tenant) {
+  if (target < 0 || target >= world_ || target == rank_)
+    return kErrInvalidArg;
+  PingConn& pc = *ping_conns_[target];
+  std::lock_guard<std::mutex> lock(pc.mu);
+  if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
+  WireResp resp;
+  if (!ControlRoundTrip(pc, pin ? kOpSnapPin : kOpSnapUnpin, tenant,
+                        /*timeout_ms=*/5000, &resp, snap_id))
+    return kErrTransport;
+  return resp.status;
+}
+
+int TcpTransport::SetTenantLaneBudget(const std::string& tenant,
+                                      int lanes) {
+  std::lock_guard<std::mutex> lock(lane_mu_);
+  if (lanes <= 0)
+    tenant_lane_budget_.erase(tenant);
+  else
+    tenant_lane_budget_[tenant].lanes = lanes;
+  tenant_budgets_set_.store(!tenant_lane_budget_.empty(),
+                            std::memory_order_relaxed);
+  return kOk;
+}
+
+int TcpTransport::TenantLaneBudget(const std::string& name,
+                                   uint64_t* rot,
+                                   const std::string& as_tenant) {
+  if (!tenant_budgets_set_.load(std::memory_order_relaxed)) return 0;
+  // The READING tenant owns the budget: a named tenant streaming the
+  // shared default namespace burns its own lanes, not the default
+  // tenant's (mirrors the async admission gate's as_tenant rule).
+  const std::string tenant =
+      as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
+  std::lock_guard<std::mutex> lock(lane_mu_);
+  auto it = tenant_lane_budget_.find(tenant);
+  if (it == tenant_lane_budget_.end()) return 0;
+  // Rotate the tenant's lane window one slot per batch: a budget-1
+  // tenant camping on pool index 0 forever would turn lane 0 into a
+  // hotspot every OTHER tenant's full-width stripes must queue behind
+  // — the budget would throttle the tenants it is meant to protect.
+  // Time-sharing the window across the pool spreads a budgeted
+  // tenant's load uniformly instead.
+  if (rot) *rot = it->second.rotor++;
+  return it->second.lanes;
 }
 
 int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
@@ -1298,7 +1374,7 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
 
 int TcpTransport::ReadVOnRetry(Peer& p, int lane0, int nlanes,
                                const std::string& name, const ReadOp* ops,
-                               int64_t n, int target) {
+                               int64_t n, int target, int lane_off) {
   // Transport-level failures (connection reset, truncated frame, read
   // timeout, failed dial) are transient: a retry can save the op —
   // ReadVOn resets the failed lane and the retry ROTATES to the next
@@ -1312,8 +1388,14 @@ int TcpTransport::ReadVOnRetry(Peer& p, int lane0, int nlanes,
   // Classification/backoff/counter policy lives in RetryTransientLoop,
   // shared with the Store-level layer.
   if (nlanes < 1) nlanes = 1;
+  const size_t pool = p.conns.size();
+  // Window index -> pool index (tenant QoS rotation; off 0 on a
+  // prefix window is the identity).
+  const auto pool_lane = [&](int wi) {
+    return static_cast<size_t>(lane_off + wi) % pool;
+  };
   int att = 0;
-  Conn* used = p.conns[static_cast<size_t>(lane0)].get();
+  Conn* used = p.conns[pool_lane(lane0)].get();
   // Snapshot the store's suspect oracle ONCE per leaf (one uncontended
   // lock amortized over the whole pipelined frame sequence); the
   // per-attempt checks below are then plain calls into the store's
@@ -1335,7 +1417,7 @@ int TcpTransport::ReadVOnRetry(Peer& p, int lane0, int nlanes,
       static_cast<uint64_t>(target) * 0x9e3779b97f4a7c15ULL +
           static_cast<uint64_t>(lane0),
       [&]() {
-        used = p.conns[static_cast<size_t>((lane0 + att) % nlanes)].get();
+        used = p.conns[pool_lane((lane0 + att) % nlanes)].get();
         return ReadVOn(p, *used, name, ops, n);
       },
       [&]() {
@@ -1366,48 +1448,85 @@ int TcpTransport::ReadV(int target, const std::string& name, const ReadOp* ops,
   return ReadVMulti(name, &req, 1);
 }
 
+bool TcpTransport::ProbeCmaInfoLocked(Peer& p, Conn& c,
+                                      std::string* payload) {
+  // ANY failure after the request is sent must reset the connection
+  // (same convention as ReadVOn's fail()): a late CmaInfo response
+  // left in the stream would be consumed by the next TCP read as its
+  // own.
+  if (EnsureConnected(p, c) != kOk) return false;
+  WireReq req{kMagic, kOpCmaInfo, rank_, 0, 0, 0, 0};
+  WireResp resp;
+  bool ok = FullSend(c.fd, &req, sizeof(req)) == 0 &&
+            FullRecv(c.fd, &resp, sizeof(resp)) == 0 &&
+            resp.status == kOk && resp.nbytes > 0 && resp.nbytes <= 4096;
+  if (ok) {
+    payload->resize(static_cast<size_t>(resp.nbytes));
+    ok = FullRecv(c.fd, &(*payload)[0], payload->size()) == 0;
+  }
+  if (!ok) {
+    ::close(c.fd);
+    c.fd = -1;
+  }
+  return ok;
+}
+
 CmaPeer* TcpTransport::EnsureCmaPeer(Peer& p, int target) {
   if (!cma_reg_) return nullptr;  // if we can't publish, don't probe either
-  std::lock_guard<std::mutex> lock(p.cma_mu);
-  if (p.cma_state == 1 && p.cma && p.cma->denied()) p.cma_state = -1;
-  if (p.cma_state != 0) return p.cma_state == 1 ? p.cma.get() : nullptr;
-  p.cma_state = -1;  // one probe; any failure below leaves the peer on TCP
+  uint64_t gen;
+  {
+    // Claim the one-shot probe (0 -> 2) or return the settled verdict.
+    // cma_mu is DDS_NO_BLOCKING: the dial+info round trip below runs
+    // with NO lock held, so concurrent classification peeks never
+    // stall behind a first-contact probe — they ride TCP this once and
+    // pick up the verdict on their next read (ROADMAP item 6).
+    std::lock_guard<std::mutex> lock(p.cma_mu);
+    if (p.cma_state == 1 && p.cma && p.cma->denied()) p.cma_state = -1;
+    if (p.cma_state == 1) return p.cma.get();
+    if (p.cma_state != 0) return nullptr;  // -1: TCP only; 2: probing
+    p.cma_state = 2;
+    gen = p.cma_gen;
+  }
 
-  // Info exchange over the peer's first connection. ANY failure after
-  // the request is sent must reset the connection (same convention as
-  // ReadVOn's fail()): a late CmaInfo response left in the stream would
-  // be consumed by the next TCP read as its own.
+  // Info exchange over the peer's first connection, serialized by that
+  // lane's OWN mutex (a data-lane mutex, legitimately held across wire
+  // I/O).
+  CmaPeer* opened = nullptr;
+  bool probe_ok = false;
   std::string payload;
   {
     Conn& c = *p.conns[0];
     std::lock_guard<std::mutex> clock(c.mu);
-    if (EnsureConnected(p, c) != kOk) return nullptr;
-    auto fail = [&]() {
-      ::close(c.fd);
-      c.fd = -1;
-      return nullptr;
-    };
-    WireReq req{kMagic, kOpCmaInfo, rank_, 0, 0, 0, 0};
-    if (FullSend(c.fd, &req, sizeof(req)) != 0) return fail();
-    WireResp resp;
-    if (FullRecv(c.fd, &resp, sizeof(resp)) != 0) return fail();
-    if (resp.status != kOk || resp.nbytes <= 0 || resp.nbytes > 4096)
-      return fail();
-    payload.resize(static_cast<size_t>(resp.nbytes));
-    if (FullRecv(c.fd, &payload[0], payload.size()) != 0) return fail();
+    probe_ok = ProbeCmaInfoLocked(p, c, &payload);
   }
-  long pid = 0;
-  unsigned long long start = 0;
-  char token[160] = {0}, shm[96] = {0};
-  if (std::sscanf(payload.c_str(), "%ld %llu %159s %95s", &pid, &start,
-                  token, shm) != 4)
+  if (probe_ok) {
+    long pid = 0;
+    unsigned long long start = 0;
+    char token[160] = {0}, shm[96] = {0};
+    if (std::sscanf(payload.c_str(), "%ld %llu %159s %95s", &pid,
+                    &start, token, shm) == 4 &&
+        CmaHostToken() == token && std::strcmp(shm, "-") != 0) {
+      opened = CmaPeer::Open(shm, pid, start);
+      if (opened && DebugOn())
+        std::fprintf(stderr, "[dds r%d] CMA fast path to r%d (pid %ld)\n",
+                     rank_, target, pid);
+    }
+  }
+
+  // Publish the verdict — unless UpdatePeer crossed the probe (gen
+  // bumped): the opened mapping would belong to the DEAD process, so
+  // discard it and leave the state wherever UpdatePeer reset it (the
+  // next read against the replacement re-probes from scratch).
+  std::lock_guard<std::mutex> lock(p.cma_mu);
+  if (p.cma_gen != gen) {
+    delete opened;  // never published, no concurrent user possible
     return nullptr;
-  if (CmaHostToken() != token || std::strcmp(shm, "-") == 0) return nullptr;
-  p.cma.reset(CmaPeer::Open(shm, pid, start));
-  if (!p.cma) return nullptr;
-  if (DebugOn())
-    std::fprintf(stderr, "[dds r%d] CMA fast path to r%d (pid %ld)\n",
-                 rank_, target, pid);
+  }
+  if (!opened) {
+    p.cma_state = -1;  // one probe; failure leaves the peer on TCP
+    return nullptr;
+  }
+  p.cma.reset(opened);
   p.cma_state = 1;
   return p.cma.get();
 }
@@ -1715,7 +1834,8 @@ int TcpTransport::LaneBytes(int target, int64_t* out, int cap) {
 }
 
 int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
-                             int64_t nreqs) {
+                             int64_t nreqs,
+                             const std::string& as_tenant) {
   // Same-host fast path first: whole per-peer op lists served with
   // process_vm_readv (no sockets, no serving thread, one kernel copy),
   // peers in parallel on the pool (the kernel copy runs at one core's
@@ -1871,10 +1991,13 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   // tasks, so the pool cannot self-deadlock.
   struct Leaf {
     Peer* p;
-    int lane;    // pool index of this stripe's lane
+    int lane;    // window index of this stripe's lane
     int nlanes;  // lanes this request striped over (retry rotation set)
     int target;  // peer rank, for retry classification/diagnostics
     std::vector<ReadOp> ops;
+    int off = 0; // pool offset of the lane window (tenant QoS rotation;
+                 // 0 for unbudgeted traffic = the pool prefix, exactly
+                 // the pre-tenancy lane assignment)
   };
   std::vector<Leaf> leaves;
   // Pass 1 — validate and classify. Each request's byte total is
@@ -1926,7 +2049,18 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   // so every request in it must have striped at the same width for the
   // sample to mean anything.
   LaneTuner& lane_tuner = lane_bulk ? bulk_lanes_ : scatter_lanes_;
-  const int stripe_lanes = StripeLanes(lane_tuner);
+  int stripe_lanes = StripeLanes(lane_tuner);
+  // Per-tenant QoS lane budget (planner-set share split): a budgeted
+  // tenant's batch engages at most its budget, so one tenant's bulk
+  // stripes cannot monopolize every lane/serving thread. Zero cost
+  // (one relaxed load) until a budget is configured. When the budget
+  // actually narrows this batch, the tenant's lane WINDOW rotates one
+  // pool slot per batch (see TenantLaneBudget) so the narrowed tenant
+  // time-shares the pool instead of pinning the prefix lanes.
+  uint64_t lane_rot = 0;
+  const int budget = TenantLaneBudget(name, &lane_rot, as_tenant);
+  const bool budget_capped = budget > 0 && budget < stripe_lanes;
+  if (budget_capped) stripe_lanes = budget;
   const bool lane_sample = lane_bulk || lane_scatter;
 
   // Pass 2 — build the peer × lane leaves. Fan out across the lane set
@@ -1941,13 +2075,16 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     const PeerReadV& rq = reqs[ri];
     if (rq.n == 0) continue;
     Peer& p = *peers_[rq.target];
-    const int nconn = std::min(stripe_lanes,
-                               static_cast<int>(p.conns.size()));
+    const int pool = static_cast<int>(p.conns.size());
+    const int nconn = std::min(stripe_lanes, pool);
+    const int off =
+        budget_capped && pool > 0 ? static_cast<int>(lane_rot % pool) : 0;
     const int64_t total = req_totals[static_cast<size_t>(ri)];
     if (nconn <= 1 ||
         (total < 2 * kStripeBytes && rq.n < 2 * nconn)) {
       leaves.push_back(Leaf{&p, 0, 1, rq.target,
-                            std::vector<ReadOp>(rq.ops, rq.ops + rq.n)});
+                            std::vector<ReadOp>(rq.ops, rq.ops + rq.n),
+                            off});
       continue;
     }
 
@@ -1958,7 +2095,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     for (int ci = 0; ci < nconn; ++ci)
       if (!lists[ci].empty())
         leaves.push_back(Leaf{&p, ci, nconn, rq.target,
-                              std::move(lists[ci])});
+                              std::move(lists[ci]), off});
   }
   if (leaves.empty()) return kOk;
 
@@ -1979,7 +2116,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
         *rc = ReadVOnRetry(*lf->p, lf->lane, lf->nlanes, name,
                            lf->ops.data(),
                            static_cast<int64_t>(lf->ops.size()),
-                           lf->target);
+                           lf->target, lf->off);
       });
     }
     group.LaunchMany(std::move(tasks));
@@ -1987,7 +2124,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   rcs[0] = ReadVOnRetry(*leaves[0].p, leaves[0].lane, leaves[0].nlanes,
                         name, leaves[0].ops.data(),
                         static_cast<int64_t>(leaves[0].ops.size()),
-                        leaves[0].target);
+                        leaves[0].target, leaves[0].off);
   group.Wait();
   for (int rc : rcs)
     if (rc != kOk) return rc;

@@ -177,7 +177,8 @@ class TcpTransport : public Transport {
   // one flattened leaf-task list on the persistent pool (no per-call
   // thread spawns — VERDICT round-1 weak #5).
   int ReadVMulti(const std::string& name, const PeerReadV* reqs,
-                 int64_t nreqs) override;
+                 int64_t nreqs,
+                 const std::string& as_tenant = std::string()) override;
 
   // Every read leaf carries its own bounded reconnect-and-retry (see
   // ReadVOnRetry); the Store must not add a second layer on top.
@@ -197,6 +198,17 @@ class TcpTransport : public Transport {
   // pulls unconditionally, the safe default.
   int64_t ReadVarSeq(int target, const std::string& name) override
       DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
+  // Snapshot-epoch pin/release, over the same dedicated control
+  // connection (never a data lane, no fault-injector draw — seeded
+  // chaos schedules are identical with snapshots in play).
+  int SnapshotControl(int target, int64_t snap_id, bool pin,
+                      const std::string& tenant) override
+      DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
+  // Per-tenant QoS lane budget: striped reads of `tenant`'s variables
+  // engage at most `lanes` lanes (the cost-model scheduler plans these
+  // as share-weighted splits of the tuned width; <= 0 clears). No
+  // budgets configured = zero cost on the read path.
+  int SetTenantLaneBudget(const std::string& tenant, int lanes);
   // The leaf retry layer's most recent failed target (failover names
   // the dead member of a multi-peer batch with this).
   int last_failed_peer() const override {
@@ -263,12 +275,18 @@ class TcpTransport : public Transport {
     int port DDS_GUARDED_BY(Conn::mu) = -1;
     std::vector<std::unique_ptr<Conn>> conns;
     // CMA (same-host process_vm_readv) state: 0 = unprobed, 1 = usable,
-    // -1 = TCP only. Probed lazily on first read to the peer. The
-    // one-shot probe inside EnsureCmaPeer blocks under this mutex by
-    // design (baselined): concurrent classification peeks wait out the
-    // first probe's bounded info exchange.
-    std::mutex cma_mu DDS_NO_BLOCKING DDS_ACQUIRED_BEFORE(Conn::mu);
+    // -1 = TCP only, 2 = probe in flight. Probed lazily on first read
+    // to the peer, OUTSIDE this mutex: the prober claims the probe by
+    // flipping 0 -> 2 under cma_mu, runs the dial+info exchange with
+    // no lock held (the wire leg serializes on its lane's own
+    // Conn::mu), and publishes the verdict under cma_mu — concurrent
+    // classification peeks see state 2 and ride TCP instead of
+    // blocking a DDS_NO_BLOCKING mutex for a network round trip.
+    // cma_gen invalidates an in-flight probe crossed by UpdatePeer
+    // (the opened mapping would belong to the dead process).
+    std::mutex cma_mu DDS_NO_BLOCKING;
     int cma_state DDS_GUARDED_BY(cma_mu) = 0;
+    uint64_t cma_gen DDS_GUARDED_BY(cma_mu) = 0;
     std::unique_ptr<CmaPeer> cma DDS_GUARDED_BY(cma_mu);
     // CmaPeers retired by UpdatePeer (elastic recovery). Raw pointers
     // returned by EnsureCmaPeer may still be mid-TryReadV on pool
@@ -281,6 +299,11 @@ class TcpTransport : public Transport {
 
   // Probe/return the peer's CMA mapping (nullptr = use TCP).
   CmaPeer* EnsureCmaPeer(Peer& p, int target);
+  // EnsureCmaPeer's dial+info exchange on lane 0, run with the lane's
+  // own (data) mutex held and NO cma_mu — the probe must never block a
+  // DDS_NO_BLOCKING mutex for a network round trip.
+  bool ProbeCmaInfoLocked(Peer& p, Conn& c, std::string* payload)
+      DDS_REQUIRES(Conn::mu);
 
   int EnsureConnected(Peer& p, Conn& c) DDS_REQUIRES(Conn::mu);
   // The pipelined request/response loop over one connection.
@@ -295,8 +318,12 @@ class TcpTransport : public Transport {
   // connected) lane — the failed lane was closed by ReadVOn's fail() and
   // redials lazily on its next use. With nlanes == 1 every attempt lands
   // back on the same lane: the exact pre-lane retry contract.
+  // `lane_off` shifts the whole window to pool index (lane_off + i) %
+  // pool — the tenant QoS rotation; 0 (all unbudgeted traffic) is the
+  // pool prefix, the exact pre-tenancy indexing.
   int ReadVOnRetry(Peer& p, int lane0, int nlanes, const std::string& name,
-                   const ReadOp* ops, int64_t n, int target);
+                   const ReadOp* ops, int64_t n, int target,
+                   int lane_off = 0);
   void AcceptLoop(int lfd, bool is_tcp);
   void HandleConnection(int fd);
   // Send one one-way barrier notify for (tag, round) to `target`.
@@ -356,13 +383,15 @@ class TcpTransport : public Transport {
   int EnsureControlConn(PingConn& pc, long timeout_ms)
       DDS_REQUIRES(PingConn::mu);
   // One control-plane request/response over the peer's dedicated
-  // connection (the shared body of Ping and ReadVarSeq): sends `op`
-  // (+ name for ops that carry one), receives `resp`. False on any
+  // connection (the shared body of Ping/ReadVarSeq/SnapshotControl):
+  // sends `op` (+ name for ops that carry one; `tag` rides the frame's
+  // tag field — the snapshot id), receives `resp`. False on any
   // failure (connection closed for a fresh redial). Caller holds
   // pc.mu.
   bool ControlRoundTrip(PingConn& pc, uint32_t op,
                         const std::string& name, long timeout_ms,
-                        void* resp) DDS_REQUIRES(PingConn::mu);
+                        void* resp, int64_t tag = 0)
+      DDS_REQUIRES(PingConn::mu);
 
   // Store-installed suspect oracle for the leaf retry layer (null =
   // never suspected). ReadVOnRetry snapshots it ONCE per leaf under
@@ -478,6 +507,23 @@ class TcpTransport : public Transport {
   std::mutex lane_mu_ DDS_NO_BLOCKING;
   LaneTuner bulk_lanes_ DDS_GUARDED_BY(lane_mu_);
   LaneTuner scatter_lanes_ DDS_GUARDED_BY(lane_mu_);
+  // Per-tenant QoS lane budgets (SetTenantLaneBudget). The atomic flag
+  // keeps the unconfigured read path at a single relaxed load. `rotor`
+  // rotates the tenant's lane window one pool slot per batch so a
+  // narrow budget time-shares the pool instead of camping on lane 0
+  // (which every other tenant's full-width stripes include).
+  struct TenantLanes {
+    int lanes = 0;
+    uint64_t rotor = 0;
+  };
+  std::map<std::string, TenantLanes> tenant_lane_budget_
+      DDS_GUARDED_BY(lane_mu_);
+  std::atomic<bool> tenant_budgets_set_{false};
+  // Budget lookup for one request's READING tenant — `as_tenant`, or
+  // derived from the variable name when "" (0 = unbudgeted); on a hit,
+  // also ticks and returns the tenant's window rotation.
+  int TenantLaneBudget(const std::string& name, uint64_t* rot,
+                       const std::string& as_tenant);
   // Lanes the NEXT striped read of the class should engage (the parked
   // count, or the level currently being measured).
   int StripeLanes(LaneTuner& t);

@@ -119,6 +119,17 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_SCHED_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_SOAK_BUDGET_S", "config"),
     _k("DDSTORE_SOAK_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_TENANTS_PHASE_TIMEOUT_S", "config",
+       desc="bench tenants-phase subprocess cap, default 300"),
+    _k("DDSTORE_TENANT_QUOTAS", "config",
+       desc="per-tenant registration budgets 't=bytes[:vars],...' "
+            "(< 0 = unlimited); an over-budget add/init is refused "
+            "with ERR_QUOTA (-11), a distinct non-fatal class"),
+    _k("DDSTORE_TENANT_SHARES", "config",
+       desc="per-tenant QoS weights 't=weight,...': async admission "
+            "is share-split (each tenant runs at most max(1, width * "
+            "share / total) concurrent async reads) and the scheduler "
+            "plans matching per-tenant lane budgets"),
     _k("DDSTORE_UDS", "config"),
     _k("DDSTORE_WORLD", "config"),
 ]}

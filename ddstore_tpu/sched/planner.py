@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import os
 import threading
+import warnings
 import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -116,6 +117,11 @@ class Plan:
     width: Optional[int] = None
     predicted_gbps: Dict[str, float] = field(default_factory=dict)
     pins: Dict[str, object] = field(default_factory=dict)
+    #: Per-tenant QoS budgets ({tenant: {"width": w, "lanes": l}}),
+    #: share-weighted splits of the planned width/lane cells — the
+    #: tenancy layer rides the SAME plan, not a fourth tuner. Empty
+    #: without configured shares.
+    tenants: Dict[str, Dict[str, int]] = field(default_factory=dict)
     reason: str = ""
     #: True once apply() actually set at least one knob.
     engaged: bool = False
@@ -321,7 +327,55 @@ class Scheduler:
             if not isinstance(depth, int):
                 plan.depth = self.model.plan_depth(self.requested_depth,
                                                    width)
+        # Per-tenant QoS budgets: share-weighted splits of the planned
+        # (or pinned/live) width and the widest planned lane cell —
+        # additional cells of the SAME joint plan. The async half is
+        # enforced natively by the admission gate; the lane half is
+        # applied through SetTenantLaneBudget in apply().
+        shares = self._tenant_shares()
+        if shares:
+            from ..tenant import share_split
+
+            width_base = plan.width if plan.width else \
+                pins.get("width") if isinstance(pins.get("width"), int) \
+                else self._live_width()
+            lane_base = max([l for l in plan.lanes.values() if l] or
+                            [self._live_lanes()])
+            widths = share_split(max(1, int(width_base)), shares)
+            lanes = share_split(max(1, int(lane_base)), shares)
+            plan.tenants = {t: {"width": widths[t], "lanes": lanes[t]}
+                            for t in shares}
         return plan
+
+    def _tenant_shares(self) -> Dict[str, int]:
+        """Configured QoS shares, read from the store's ledger (env or
+        runtime setters). {} = tenancy not in play."""
+        if self.store is None or not hasattr(self.store, "tenant_stats"):
+            return {}
+        try:
+            stats = self.store.tenant_stats()
+        except Exception:
+            return {}
+        # The share gauge is 0 for tenants that never ran
+        # SetTenantShare (quota-only, snapshot-pin-only rows): only
+        # EXPLICITLY configured tenants enter the split, so the
+        # planner's denominator is the native gate's
+        # async_share_total_ — sum of configured weights, even when
+        # every configured weight is 1.
+        shares = {t: int(row.get("share", 0)) for t, row in stats.items()}
+        return {t: w for t, w in shares.items() if w > 0}
+
+    def _live_width(self) -> int:
+        try:
+            return int(self.store.async_width)
+        except Exception:
+            return 1
+
+    def _live_lanes(self) -> int:
+        try:
+            return int(self.store.lane_state().get("max_lanes", 1) or 1)
+        except Exception:
+            return 1
 
     def apply(self, plan: Plan) -> Plan:
         """Push the plan's unpinned knobs through the native setters.
@@ -344,6 +398,23 @@ class Scheduler:
             plan.engaged = True
         if plan.depth is not None and "depth" not in plan.pins:
             plan.engaged = True  # consumed by the loader (planned_depth)
+        if plan.tenants and hasattr(self.store, "set_tenant_lane_budget"):
+            # Lane half of the tenant QoS budgets (the async half is
+            # enforced natively by the share-aware admission gate).
+            # Non-TCP backends never raise (the native call is a no-op
+            # there), so any exception is a REAL failure — surface it
+            # and do not record the budgets as engaged.
+            applied = 0
+            for tenant, budget in plan.tenants.items():
+                try:
+                    self.store.set_tenant_lane_budget(tenant,
+                                                      budget["lanes"])
+                    applied += 1
+                except Exception as e:
+                    warnings.warn(
+                        f"tenant lane budget {tenant!r} not applied: "
+                        f"{e}", RuntimeWarning, stacklevel=2)
+            plan.engaged = plan.engaged or applied > 0
         return plan
 
     def replan(self, reason: str) -> Plan:
@@ -423,7 +494,9 @@ class Scheduler:
                 "engaged": plan.engaged,
                 "plan": {"route": dict(plan.route),
                          "lanes": dict(plan.lanes),
-                         "depth": plan.depth, "width": plan.width},
+                         "depth": plan.depth, "width": plan.width,
+                         "tenants": {t: dict(b) for t, b in
+                                     plan.tenants.items()}},
                 "pins": dict(plan.pins),
                 "predicted_gbps": dict(plan.predicted_gbps),
                 "measured_window_gbps": measured,

@@ -102,6 +102,18 @@ class AsyncBatchRead:
             self._native.async_release(self._ticket)
 
 
+def _check_varname(name: str) -> None:
+    """Control characters are the native registry's namespace
+    machinery (\\x01 mirrors, \\x02 tenant scopes, \\x03 snapshot
+    views) — a user name carrying one could alias a hidden variable."""
+    if not name:
+        raise ValueError("variable name must be non-empty")
+    if any(ord(c) < 0x20 for c in name):
+        raise ValueError(f"variable name {name!r} contains control "
+                         f"characters (reserved for the native "
+                         f"namespace machinery)")
+
+
 def _row_disp(sample_shape: Tuple[int, ...]) -> int:
     """Row displacement (elements per sample) — THE single derivation
     shared by add/init/add_mmap and the elastic rejoin path."""
@@ -227,6 +239,10 @@ class DDStore:
         self.backend = backend
         self.copy = copy
         self._meta: Dict[str, _VarMeta] = {}
+        # One metadata registry per NAMED tenant, shared by every handle
+        # of that tenant (see tenant/handle.py): a second attach — a
+        # snapshot reader included — must resolve the tenant's variables.
+        self._tenant_meta: Dict[str, Dict[str, _VarMeta]] = {}
         self._barrier_tag = 1 << 32  # distinct from epoch tags
 
         rank, world = self.group.rank, self.group.size
@@ -278,6 +294,7 @@ class DDStore:
         distdataset.py:63,84 where ``disp=1`` made row != sample).
         ``copy`` overrides the store default (False borrows the buffer —
         how mmap-backed tiering serves from page cache)."""
+        _check_varname(name)
         copy = self.copy if copy is None else copy
         arr = np.ascontiguousarray(arr)
         if arr.ndim == 0:
@@ -292,7 +309,7 @@ class DDStore:
             raise DDStoreError(-9, f"add({name}): ranks disagree on "
                                    f"dtype/sample shape: {sorted(shapes)}")
         all_nrows = [m[0] for m in metas]
-        self._native.add(name, arr, all_nrows, copy=copy)
+        self._native.add(self._wname(name), arr, all_nrows, copy=copy)
         # A borrowed buffer the caller can't write (e.g. a frombuffer
         # view over an immutable bytes object) must refuse update() with
         # a DDStoreError, not let the native memcpy SIGSEGV on the
@@ -312,6 +329,7 @@ class DDStore:
              dtype) -> None:
         """Register a zero-filled shard for deferred population (reference
         ``init``, pyddstore.pyx:112-113)."""
+        _check_varname(name)
         dtype = np.dtype(dtype)
         disp = _row_disp(tuple(sample_shape))
         metas = self.group.allgather((int(nrows), dtype.str,
@@ -320,7 +338,8 @@ class DDStore:
         if len(shapes) != 1:
             raise DDStoreError(-9, f"init({name}): ranks disagree")
         all_nrows = [m[0] for m in metas]
-        self._native.init(name, nrows, disp, dtype.itemsize, all_nrows)
+        self._native.init(self._wname(name), nrows, disp,
+                          dtype.itemsize, all_nrows)
         self._meta[name] = _VarMeta(dtype, tuple(sample_shape), disp,
                                     all_nrows)
         self.barrier()
@@ -339,7 +358,7 @@ class DDStore:
         ``refresh_mirrors`` or the next epoch fence retries the pull."""
         if self.replication > 1 and self.world > 1:
             try:
-                self._native.replicate(name)
+                self._native.replicate(self._wname(name))
             except DDStoreError as e:
                 import warnings
 
@@ -362,7 +381,7 @@ class DDStore:
             raise ValueError(
                 f"update({name}): sample shape {tuple(arr.shape[1:])} != "
                 f"registered {m.sample_shape}")
-        self._native.update(name, arr, row_offset)
+        self._native.update(self._wname(name), arr, row_offset)
 
     # -- reads -------------------------------------------------------------
 
@@ -375,7 +394,8 @@ class DDStore:
         m = self._require(name)
         out = self._check_out(name, m, out, count)
         try:
-            self._native.get(name, out, start, count)
+            self._native.get(self._rname(name), out, start, count,
+                             tenant=self._read_tenant())
         except DDStoreError as e:
             raise self._classify(e, name,
                                  np.arange(start, start + count)) from None
@@ -390,7 +410,8 @@ class DDStore:
         idx = np.ascontiguousarray(indices, dtype=np.int64).reshape(-1)
         out = self._check_out(name, m, out, len(idx))
         try:
-            self._native.get_batch(name, out, idx)
+            self._native.get_batch(self._rname(name), out, idx,
+                                   tenant=self._read_tenant())
         except DDStoreError as e:
             raise self._classify(e, name, idx) from None
         return out
@@ -405,7 +426,8 @@ class DDStore:
         m = self._require(name)
         idx = np.ascontiguousarray(indices, dtype=np.int64).reshape(-1)
         out = self._check_out(name, m, out, len(idx))
-        ticket = self._native.get_batch_async(name, out, idx)
+        ticket = self._native.get_batch_async(self._rname(name), out, idx,
+                                              tenant=self._read_tenant())
         return AsyncBatchRead(self._native, ticket, out, idx)
 
     def read_runs_async(self, name: str, out: np.ndarray, targets,
@@ -420,7 +442,8 @@ class DDStore:
         Same completion contract as :meth:`get_batch_async`."""
         self._require(name)
         ticket = self._native.read_runs_async(
-            name, out, targets, src_offsets, dst_offsets, nbytes)
+            self._rname(name), out, targets, src_offsets, dst_offsets,
+            nbytes, tenant=self._read_tenant())
         return AsyncBatchRead(self._native, ticket, out, None)
 
     def async_pending(self) -> int:
@@ -524,7 +547,7 @@ class DDStore:
                             shape=(nrows,) + tuple(m.sample_shape))
         else:  # mmap of an empty file is invalid
             arr = np.empty((0,) + tuple(m.sample_shape), m.dtype)
-        self._native.rebind(name, arr)
+        self._native.rebind(self._wname(name), arr)
         m.pinned = arr  # keep the mapping alive; old pin (if any) drops
         m.readonly = True
         # Collective completion: once any rank returns, every rank's swap
@@ -592,7 +615,9 @@ class DDStore:
         m = self._require(f"{name}/values")
         out = np.empty((int(length),) + m.sample_shape, m.dtype)
         if length:
-            self._native.get(f"{name}/values", out, int(start), int(length))
+            self._native.get(self._rname(f"{name}/values"), out,
+                             int(start), int(length),
+                             tenant=self._read_tenant())
         return out
 
     def get_ragged_batch(self, name: str, indices):
@@ -618,23 +643,25 @@ class DDStore:
                 + np.arange(total, dtype=np.int64))
         values = np.empty((total,) + m.sample_shape, m.dtype)
         if total:
-            self._native.get_batch(f"{name}/values", values, rows)
+            self._native.get_batch(self._rname(f"{name}/values"),
+                                   values, rows,
+                                   tenant=self._read_tenant())
         return values, lengths.astype(np.int64)
 
     # -- metadata ----------------------------------------------------------
 
     def query(self, name: str) -> dict:
-        info = self._native.query(name)
+        info = self._native.query(self._rname(name))
         m = self._require(name)
         info["dtype"] = m.dtype
         info["sample_shape"] = m.sample_shape
         return info
 
     def total_rows(self, name: str) -> int:
-        return int(self._native.query(name)["total_rows"])
+        return int(self._native.query(self._rname(name))["total_rows"])
 
     def local_rows(self, name: str) -> int:
-        return int(self._native.query(name)["local_rows"])
+        return int(self._native.query(self._rname(name))["local_rows"])
 
     def my_row_range(self, name: str) -> Tuple[int, int]:
         """Global [begin, end) owned by this rank."""
@@ -692,10 +719,10 @@ class DDStore:
         self.barrier()
         if name is None:
             for n in list(self._meta):
-                self._native.free_var(n)
+                self._native.free_var(self._wname(n))
                 del self._meta[n]
         else:
-            self._native.free_var(name)
+            self._native.free_var(self._wname(name))
             self._meta.pop(name, None)
 
     def close(self) -> None:
@@ -890,6 +917,104 @@ class DDStore:
     @property
     def world(self) -> int:
         return self.group.size
+
+    # -- tenant namespaces / snapshot epochs -------------------------------
+    #
+    # The root DDStore IS the default tenant "": both hooks are the
+    # identity, so every pre-tenancy call path (and its native names) is
+    # byte-identical. ``attach()`` returns a TenantHandle whose hooks
+    # scope registrations to "\x02<tenant>\x02<name>" and (for
+    # ``snapshot=True``) wrap reads in a pinned snapshot view.
+
+    def _wname(self, name: str) -> str:
+        """Native registry name for writes/registration."""
+        return name
+
+    def _rname(self, name: str) -> str:
+        """Native registry name for reads/metadata."""
+        return name
+
+    def _read_tenant(self) -> str:
+        """Tenant label async reads are admitted (QoS shares) and
+        ledgered under. "" on the root store = derive from the variable
+        name, the pre-tenancy behavior; a TenantHandle reports its own
+        label so reads of the SHARED default namespace still count
+        against the reading tenant's share."""
+        return ""
+
+    def attach(self, tenant: str = "", snapshot: bool = False):
+        """Attach a tenant-scoped handle to this (long-lived, shared)
+        store. The handle shares the native store, group and rank but
+        scopes every registration to its own namespace — handles of
+        different tenants cannot see, read, update, or free each
+        other's variables. The DEFAULT namespace (variables registered
+        through this root store) stays readable from every handle —
+        that is how an eval or inference job attaches to the resident
+        training shards.
+
+        ``snapshot=True`` additionally pins the CURRENT content version
+        of every shard on every rank: the handle is read-only and its
+        reads stay byte-stable while the owner keeps calling
+        ``update()`` + epoch fences (copy-on-publish keeps the pinned
+        version for updated shards only; ``detach()`` — or the context
+        manager exit — releases the pins and reclaims kept copies on
+        last detach). The acquire places pins rank by rank, so do not
+        race it against a writer's ``update``: attach at a quiescent
+        point (between epoch fences, or after a ``barrier()`` with the
+        writer) or the snapshot may pin different content versions on
+        different ranks. Updates landing AFTER the acquire are exactly
+        what the pins protect against."""
+        from .tenant import TenantHandle
+
+        return TenantHandle(self, tenant, snapshot=snapshot)
+
+    def set_tenant_quota(self, tenant: str, max_bytes: int,
+                         max_vars: int = -1) -> None:
+        """Byte/var registration budget for ``tenant`` (< 0 =
+        unlimited; runtime equivalent of ``DDSTORE_TENANT_QUOTAS``).
+        An over-budget ``add``/``init`` raises ``DDStoreError`` with
+        code ``ERR_QUOTA`` (-11) — admission refused, nothing died."""
+        self._check_tenant_label(tenant)
+        self._native.tenant_set_quota(tenant, max_bytes, max_vars)
+
+    def set_tenant_share(self, tenant: str, share: int) -> None:
+        """Async-admission weight (runtime equivalent of
+        ``DDSTORE_TENANT_SHARES``): with any share configured, each
+        tenant runs at most ``max(1, width * share / total)``
+        concurrent async batched reads — one tenant's readahead cannot
+        starve another's scatter reads."""
+        self._check_tenant_label(tenant)
+        self._native.tenant_set_share(tenant, share)
+
+    def set_tenant_lane_budget(self, tenant: str, lanes: int) -> None:
+        """QoS lane budget: cap the transport lanes ``tenant``'s
+        striped reads engage (<= 0 clears; the cost-model scheduler
+        plans these from the shares). No-op on non-TCP backends."""
+        self._check_tenant_label(tenant)
+        self._native.tenant_set_lane_budget(tenant, lanes)
+
+    @staticmethod
+    def _check_tenant_label(tenant: str) -> None:
+        """Every native entry point keyed by a tenant label goes
+        through here: control characters collide with the native
+        name-scoping / names-CSV formats, and the env-spec delimiters
+        would desynchronize the Python ledger from the native gate."""
+        from .tenant.handle import _check_tenant_label
+
+        _check_tenant_label(tenant)
+
+    def tenant_stats(self) -> Dict[str, dict]:
+        """Per-tenant ledger: ``{tenant: {bytes, vars, quota_*,
+        read/served traffic, async admissions/deferrals, snapshot
+        pins, share}}`` (see ``binding.TENANT_STAT_KEYS``). Monotone
+        counters diff per epoch via ``summary()["tenants"]``."""
+        return {t: self._native.tenant_stats(t)
+                for t in self._native.tenant_names()}
+
+    def snapshot_stats(self) -> dict:
+        """This rank's snapshot gauges: active pins, kept versions and
+        their RAM cost (the copy-on-publish ledger)."""
+        return self._native.snapshot_stats()
 
     def _require(self, name: str) -> _VarMeta:
         if name not in self._meta:

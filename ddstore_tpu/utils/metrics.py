@@ -189,6 +189,13 @@ class PipelineMetrics:
         # summary()["sched"] is how a bench record explains WHY each
         # transport knob was set this epoch.
         self._sched_source: Optional[Callable[[], Dict]] = None
+        # Per-tenant ledger source (DDStore.tenant_stats): snapshotted
+        # at epoch boundaries, summary()["tenants"] carries the
+        # per-tenant deltas (quota rejections, admissions/deferrals,
+        # read/served traffic) plus the live gauges.
+        self._tenant_source: Optional[Callable[[], Dict]] = None
+        self._tenant_begin: Optional[Dict] = None
+        self._tenant_end: Optional[Dict] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -287,6 +294,51 @@ class PipelineMetrics:
             else:
                 out[k] = max(0, int(end[k]) - int(
                     self._failover_begin.get(k, 0)))
+        return out
+
+    #: gauge keys of the tenant source (reported raw, never delta'd —
+    #: keep in sync with binding.TENANT_GAUGE_KEYS).
+    TENANT_GAUGES = ("quota_bytes", "quota_vars", "bytes", "vars",
+                     "snapshot_pins", "share")
+
+    def set_tenant_source(self,
+                          source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning the per-tenant ledger
+        (``DDStore.tenant_stats`` — ``{tenant: {counter: value}}``).
+        Snapshotted at epoch boundaries; ``summary()["tenants"]``
+        reports per-tenant per-epoch deltas (gauges raw) — how an
+        epoch record proves "the capped tenant was rejected/deferred,
+        the others kept their throughput" on its own."""
+        self._tenant_source = source
+
+    def _snap_tenants(self) -> Optional[Dict]:
+        if self._tenant_source is None:
+            return None
+        try:
+            return {t: dict(v) for t, v in self._tenant_source().items()}
+        except Exception:
+            return None
+
+    def tenant_summary(self) -> Dict:
+        """Per-epoch tenant view: counter deltas + the live gauges,
+        one row per tenant (tenants appearing mid-epoch delta against
+        an implicit zero baseline)."""
+        out: Dict = {}
+        if self._tenant_begin is None:
+            return out
+        end = self._tenant_end if self._tenant_end is not None \
+            else self._snap_tenants()
+        if end is None:
+            return out
+        for tenant, row in end.items():
+            begin = self._tenant_begin.get(tenant, {})
+            trow: Dict = {}
+            for k, v in row.items():
+                if k in self.TENANT_GAUGES:
+                    trow[k] = int(v)
+                else:
+                    trow[k] = max(0, int(v) - int(begin.get(k, 0)))
+            out[tenant] = trow
         return out
 
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
@@ -426,6 +478,8 @@ class PipelineMetrics:
         self._fault_end = None
         self._failover_begin = self._snap_failover()
         self._failover_end = None
+        self._tenant_begin = self._snap_tenants()
+        self._tenant_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -445,6 +499,7 @@ class PipelineMetrics:
         self._plan_end = self._snap_plan()
         self._fault_end = self._snap_faults()
         self._failover_end = self._snap_failover()
+        self._tenant_end = self._snap_tenants()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -497,6 +552,15 @@ class PipelineMetrics:
                    or any(v for k, v in fo.items()
                           if k not in self.FAILOVER_GAUGES)):
             out["failover"] = fo
+        tn = self.tenant_summary()
+        # Included when any tenant beyond the bare default is known, or
+        # any tenant activity fired — a multi-tenant epoch's record
+        # shows quota/QoS behavior on its own; single-tenant default
+        # epochs stay unchanged.
+        if tn and (set(tn) != {""} or
+                   any(v for k, v in tn.get("", {}).items()
+                       if k not in self.TENANT_GAUGES)):
+            out["tenants"] = tn
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself

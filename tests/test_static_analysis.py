@@ -78,8 +78,14 @@ class TestWholeTree:
         conn = m.classes["TcpTransport::Conn"]
         assert "mu" in conn.mutexes and conn.guarded["fd"] == "Conn::mu"
         # declared order edge seeded into the graph
-        assert m.classes["TcpTransport::Peer"].acquired_before[
-            "cma_mu"] == ["Conn::mu"]
+        assert store.acquired_before["mu_"] == ["CmaRegistry::mu_"]
+        assert store.acquired_before["async_mu_"] == ["WorkerPool::mu_"]
+        # the ISSUE 9 EnsureCmaPeer restructure moved the discovery
+        # probe OUTSIDE cma_mu, so the old cma_mu -> Conn::mu order
+        # edge no longer exists (and must not creep back: it was the
+        # blocking-under-lock hazard the restructure removed)
+        assert "cma_mu" not in \
+            m.classes["TcpTransport::Peer"].acquired_before
         # functions were found in every native TU
         files_with_fns = {f.file for f in m.functions}
         for tu in ("store.cc", "tcp_transport.cc", "health.cc",
