@@ -855,6 +855,129 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
     return out
 
 
+def trace_bench(world=4, num=16384, dim=64, batch=256, pairs=5):
+    """ddtrace A/B (ISSUE 10 acceptance): the 4-owner ThreadGroup TCP
+    scatter workload runs INTERLEAVED off/on pairs — byte-identity of
+    the traced epoch asserted against a locally reconstructed oracle
+    BEFORE any timing — and ``trace_ok`` gates on (a) tracing actually
+    ENGAGED (spans minted, serve legs recorded cross-rank under the
+    requester's spans), (b) identity, and (c) median on/off wall
+    overhead <= 10%. Interleaving + medians is the house style against
+    this box's ~3x CPU noise; DDSTORE_CMA=0 forces the wire path so the
+    frame-tag propagation (the off-state byte-identity contract's other
+    half) is what gets timed."""
+    import threading
+    import uuid
+
+    import numpy as np
+
+    from ddstore_tpu import DDStore, ThreadGroup
+    from ddstore_tpu import binding as _b
+
+    env = {"DDSTORE_CMA": "0"}
+    backup = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    out = {}
+    errors = []
+    name = uuid.uuid4().hex
+    rows = num // world
+
+    def shard_of(rank):
+        return np.random.default_rng(31 + rank).standard_normal(
+            (rows, dim)).astype(np.float32)
+
+    try:
+        def run_rank(rank):
+            g = ThreadGroup(name, rank, world)
+            with DDStore(g, backend="tcp") as s:
+                s.add("v", shard_of(rank))
+                s.barrier()
+                if rank == 0:
+                    oracle = np.concatenate(
+                        [shard_of(r) for r in range(world)])
+                    dst = np.empty((batch, dim), np.float32)
+
+                    def epoch(seed):
+                        rng = np.random.default_rng(seed)
+                        t0 = time.perf_counter()
+                        for _ in range(24):
+                            idx = rng.integers(0, num, batch)
+                            s.get_batch("v", idx, out=dst)
+                        return time.perf_counter() - t0
+
+                    # Identity BEFORE timing, traced: the tagged frames
+                    # must return exactly the owner's bytes.
+                    _b.trace_configure(1)
+                    _b.trace_reset()
+                    ver = np.random.default_rng(9).integers(0, num, 512)
+                    np.testing.assert_array_equal(
+                        s.get_batch("v", ver), oracle[ver])
+                    ev = _b.trace_dump()
+                    st = _b.trace_stats()
+                    serve = ev[ev["type"]
+                               == _b.TRACE_TYPE_CODES["serve_begin"]]
+                    spans0 = {int(x) for x in ev[
+                        ev["type"] == _b.TRACE_TYPE_CODES["op_begin"]]
+                        ["span"]}
+                    engaged = bool(
+                        st["captured"] > 0 and st["spans"] > 0
+                        and len(serve) > 0
+                        and {int(x) for x in serve["span"]} & spans0)
+                    out["trace_events_captured"] = int(st["captured"])
+                    out["trace_spans"] = int(st["spans"])
+                    out["trace_serve_events"] = int(len(serve))
+                    out["trace_engaged"] = engaged
+                    out["trace_identity_ok"] = True  # assert passed
+
+                    # Interleaved off/on timing pairs, medians.
+                    t_off, t_on = [], []
+                    for p in range(pairs):
+                        _b.trace_configure(0)
+                        t_off.append(epoch(100 + p))
+                        _b.trace_configure(1)
+                        t_on.append(epoch(100 + p))
+                    _b.trace_configure(0)
+                    _b.trace_reset()
+                    off_s = float(np.median(t_off))
+                    on_s = float(np.median(t_on))
+                    nbytes = 24 * batch * dim * 4
+                    overhead = on_s / off_s if off_s > 0 else 0.0
+                    out.update({
+                        "trace_off_gbps": round(nbytes / off_s / 1e9, 3),
+                        "trace_on_gbps": round(nbytes / on_s / 1e9, 3),
+                        "trace_overhead_x": round(overhead, 3),
+                        "trace_ok": bool(engaged and overhead <= 1.10),
+                    })
+                s.barrier()
+
+        def body(rank):
+            try:
+                run_rank(rank)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(240)
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("trace_bench rank thread hung past its "
+                               "240 s join")
+    finally:
+        _b.trace_configure(0)
+        _b.trace_reset()
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def tenants_bench(world=4, num=16384, dim=64, batch=256, epochs=8):
     """Multi-tenant service A/B (ISSUE 9 acceptance): two concurrent
     attached jobs over one 4-owner ThreadGroup store.
@@ -1205,9 +1328,30 @@ identical = len(chaos) == len(ref) and all(
     np.array_equal(a, b) for a, b in zip(ref, chaos))
 detect_s = latency.get("detect_s", -1.0)
 summary = loader.metrics.summary() if loader is not None else {}
+# ddtrace evidence (DDSTORE_TRACE=1 in this worker's env): the kill
+# must have auto-triggered the flight recorder at the suspect verdict,
+# and a post-epoch snapshot's span tree must name the dead peer, the
+# verdict, and every replica-rerouted op.
+from ddstore_tpu import binding as _tb
+from ddstore_tpu import obs as _obs
+auto_flights = _tb.trace_stats()["flight_dumps"]
+_tb.trace_flight("manual", 0)
+fl = _tb.trace_flight_dump()
+tree = _obs.span_tree(fl, max_spans=1 << 20)
+n_failover_evts = int((fl["type"]
+                       == _tb.TRACE_TYPE_CODES["failover"]).sum())
+reroutes = fo["failover_reads"] - fo0["failover_reads"]
+trace_ok = bool(
+    auto_flights > 0                              # verdict snapshotted
+    and f"suspect (peer={victim}" in tree         # verdict named
+    and f"dead_owner={victim}" in tree            # reroutes named
+    and n_failover_evts >= max(1, reroutes))      # every rerouted op
 result = {
     "failover_epoch_identical": bool(identical),
     "failover_peer_lost_raised": peer_lost,
+    "failover_flight_dumps_auto": int(auto_flights),
+    "failover_trace_failover_events": n_failover_evts,
+    "failover_trace_ok": trace_ok,
     "failover_giveups": fs["retry_giveups"] - fs0["retry_giveups"],
     "failover_reads": fo["failover_reads"] - fo0["failover_reads"],
     "failover_suspect_skips": fo["suspect_skips"] - fo0["suspect_skips"],
@@ -1262,6 +1406,10 @@ def failover_bench(world=4, num=8192, dim=32, batch=64, victim=2):
         DDSTORE_REPLICATION="2",
         DDSTORE_HEARTBEAT_MS="50",
         DDSTORE_HEARTBEAT_SUSPECT_N="2",
+        # ddtrace on: the kill must leave a flight-recorder story (the
+        # suspect verdict, the dead peer, every replica-rerouted op) —
+        # failover_trace_ok in the worker asserts it.
+        DDSTORE_TRACE="1",
         DDSTORE_CMA="0",
         DDSTORE_READ_TIMEOUT_S="2",
         DDSTORE_CONNECT_TIMEOUT_S="2",
@@ -2598,6 +2746,20 @@ def _phase_tenants():
     return o
 
 
+def _phase_trace():
+    o = trace_bench()
+    print(f"# trace A/B (off/on over the 4-owner scatter workload): "
+          f"{o.get('trace_off_gbps', 0):.2f} -> "
+          f"{o.get('trace_on_gbps', 0):.2f} GB/s "
+          f"({o.get('trace_overhead_x', 0):.3f}x wall), "
+          f"{o.get('trace_events_captured', 0)} events / "
+          f"{o.get('trace_spans', 0)} spans captured, "
+          f"{o.get('trace_serve_events', 0)} cross-rank serve legs "
+          f"under requester spans, byte-identical -> "
+          f"{'OK' if o.get('trace_ok') else 'NOT OK'}", file=sys.stderr)
+    return o
+
+
 def _phase_failover():
     o = failover_bench()
     print(f"# failover (R=2, owner SIGKILLed mid-epoch): epoch "
@@ -2606,8 +2768,12 @@ def _phase_failover():
           f"({o.get('failover_suspect_skips', 0)} detector "
           f"short-circuits), {o.get('failover_giveups', 0)} give-ups, "
           f"{o.get('failover_peer_lost_raised', 0)} kErrPeerLost, "
-          f"suspected in {o.get('failover_detect_s', -1):.2f}s -> "
-          f"{'OK' if o.get('failover_ok') else 'NOT OK'}",
+          f"suspected in {o.get('failover_detect_s', -1):.2f}s; flight "
+          f"recorder {o.get('failover_flight_dumps_auto', 0)} auto "
+          f"dump(s), {o.get('failover_trace_failover_events', 0)} "
+          f"rerouted ops in the span tree "
+          f"(trace {'OK' if o.get('failover_trace_ok') else 'NOT OK'}) "
+          f"-> {'OK' if o.get('failover_ok') else 'NOT OK'}",
           file=sys.stderr)
     return o
 
@@ -2661,7 +2827,7 @@ _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong),
            ("ppsched", _phase_ppsched), ("chaos", _phase_chaos),
            ("failover", _phase_failover), ("tenants", _phase_tenants),
-           ("soak", _phase_soak))
+           ("trace", _phase_trace), ("soak", _phase_soak))
 
 
 def _kill_group(proc):
@@ -2754,6 +2920,10 @@ def main():
     # tenant workloads over the wire path; same own-cap pattern.
     tenants_timeout = float(os.environ.get(
         "DDSTORE_TENANTS_PHASE_TIMEOUT_S", 300))
+    # The trace phase interleaves off/on scatter epochs over the wire
+    # path; same own-cap pattern as the other host-only diagnostics.
+    trace_timeout = float(os.environ.get(
+        "DDSTORE_TRACE_PHASE_TIMEOUT_S", 300))
     # The lanes A/B runs three full store lifetimes (1-lane, N-lane,
     # autotuned) over the wire path; its own cap (soak/ppsched/chaos
     # pattern) keeps a slow run from eating a device phase's budget.
@@ -2787,7 +2957,7 @@ def main():
     device_phases = {n for n, _ in _PHASES
                      if n not in ("local", "tcp", "readahead", "lanes",
                                   "sched", "chaos", "failover",
-                                  "tenants", "soak")}
+                                  "tenants", "trace", "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -2895,6 +3065,7 @@ def main():
                              "chaos": chaos_timeout,
                              "failover": failover_timeout,
                              "tenants": tenants_timeout,
+                             "trace": trace_timeout,
                              "lanes": lanes_timeout,
                              "sched": sched_timeout}.get(name, timeout)
             try:

@@ -180,6 +180,25 @@ def _load() -> ctypes.CDLL:
                                         ctypes.c_char_p]
     lib.dds_fault_stats.restype = ctypes.c_int
     lib.dds_fault_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_trace_configure.restype = ctypes.c_int
+    lib.dds_trace_configure.argtypes = [ctypes.c_int, ctypes.c_long]
+    lib.dds_trace_enabled.restype = ctypes.c_int
+    lib.dds_trace_enabled.argtypes = []
+    lib.dds_trace_reset.restype = ctypes.c_int
+    lib.dds_trace_reset.argtypes = []
+    lib.dds_trace_emit.restype = ctypes.c_int
+    lib.dds_trace_emit.argtypes = [ctypes.c_uint32, ctypes.c_uint64,
+                                   ctypes.c_int, _i64, _i64, _i64]
+    lib.dds_trace_new_span.restype = ctypes.c_uint64
+    lib.dds_trace_new_span.argtypes = [ctypes.c_int]
+    lib.dds_trace_flight.restype = ctypes.c_int
+    lib.dds_trace_flight.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.dds_trace_dump.restype = _i64
+    lib.dds_trace_dump.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_trace_flight_dump.restype = _i64
+    lib.dds_trace_flight_dump.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_trace_stats.restype = ctypes.c_int
+    lib.dds_trace_stats.argtypes = [_i64p]
     lib.dds_rank.restype = ctypes.c_int
     lib.dds_rank.argtypes = [ctypes.c_void_p]
     lib.dds_world.restype = ctypes.c_int
@@ -250,6 +269,129 @@ def fault_configure(spec: str, seed: int = 0,
 #: fault.cc (the readahead degraded path derives its shared-budget math
 #: from this; drift would silently hand refetches the wrong base).
 DEFAULT_OP_DEADLINE_S = 300.0
+
+
+# -- ddtrace: event-ring tracing + flight recorder ---------------------------
+#
+# Process-global like the fault injector (rings belong to THREADS, and a
+# ThreadGroup test's in-process "ranks" share one trace — every event
+# carries its emitting rank). All decode tables here mirror native
+# enums/layouts in native/trace.h; drift breaks the dump format.
+
+#: numpy layout of one dumped trace event (keep in sync with
+#: trace.h `Event` — 48 packed bytes).
+TRACE_EVENT_DTYPE = np.dtype([
+    ("t_ns", "<u8"), ("span", "<u8"), ("type", "<u2"), ("tid", "<u2"),
+    ("rank", "<i4"), ("a", "<i8"), ("b", "<i8"), ("c", "<i8")])
+
+#: event-type decode table (trace.h EventType).
+TRACE_TYPES = {
+    1: "op_begin", 2: "op_end", 3: "retry", 4: "backoff",
+    5: "lane_dial", 6: "lane_close", 7: "serve_begin", 8: "serve_end",
+    9: "cma_read", 10: "window_issue", 11: "window_ready",
+    12: "window_stall", 13: "plan_replan", 14: "plan_applied",
+    15: "suspect", 16: "suspect_clear", 17: "quota_reject",
+    18: "lane_budget_rotate", 19: "flight", 20: "failover",
+}
+#: name -> code view of :data:`TRACE_TYPES` (Python-side emitters).
+TRACE_TYPE_CODES = {v: k for k, v in TRACE_TYPES.items()}
+
+#: op classes carried in op_begin/op_end `a` (trace.h OpClass).
+TRACE_OP_CLASSES = {0: "get", 1: "get_batch", 2: "read_runs",
+                    3: "async_batch"}
+
+#: flight-recorder trigger codes (trace.h FlightReason).
+TRACE_FLIGHT_REASONS = {1: "peer_lost", 2: "quota", 3: "window_giveup",
+                        4: "suspect", 5: "manual"}
+
+#: dict keys of :func:`trace_stats`, in native layout order (keep in
+#: sync with capi dds_trace_stats / trace::Stats).
+#: ``captured``/``dropped``/``flight_dumps``/``spans`` are monotone
+#: since process start; the rest are gauges.
+TRACE_STAT_KEYS = ("enabled", "ring_events", "threads", "capacity",
+                   "live", "captured", "dropped", "flight_events",
+                   "flight_dumps", "spans")
+
+
+def trace_configure(enabled: int, ring_events: int = -1) -> None:
+    """Flip tracing on/off at runtime (``enabled`` 0/1; -1 keeps) and
+    optionally set the per-thread ring capacity for rings allocated
+    from now on (existing threads keep their rings). The load-time
+    equivalents are ``DDSTORE_TRACE`` / ``DDSTORE_TRACE_RING``."""
+    _check(_load().dds_trace_configure(int(enabled), int(ring_events)),
+           "trace_configure")
+
+
+def trace_enabled() -> bool:
+    """One native relaxed load: is tracing recording right now?"""
+    return bool(_load().dds_trace_enabled())
+
+
+def trace_reset() -> None:
+    """Drop every recorded event (rings trimmed, flight buffer
+    cleared); the monotone totals in :func:`trace_stats` keep
+    counting. Test/bench isolation hook."""
+    _check(_load().dds_trace_reset(), "trace_reset")
+
+
+def trace_emit(type_, span: int = 0, rank: int = -1, a: int = 0,
+               b: int = 0, c: int = 0) -> None:
+    """Append one event to THIS thread's ring (no-op while tracing is
+    off). ``type_`` is a :data:`TRACE_TYPES` code or name — the hook
+    Python-side emitters (readahead windows, scheduler replans) use."""
+    code = TRACE_TYPE_CODES.get(type_, type_) \
+        if isinstance(type_, str) else int(type_)
+    _load().dds_trace_emit(int(code), int(span), int(rank), int(a),
+                           int(b), int(c))
+
+
+def trace_new_span(rank: int = -1) -> int:
+    """Mint a fresh span id for a Python-side logical op."""
+    return int(_load().dds_trace_new_span(int(rank)))
+
+
+def trace_flight(reason, rank: int = -1) -> None:
+    """Trigger the flight recorder manually (``reason`` a
+    :data:`TRACE_FLIGHT_REASONS` code or name) — the readahead window
+    give-up path calls this."""
+    codes = {v: k for k, v in TRACE_FLIGHT_REASONS.items()}
+    code = codes.get(reason, reason) if isinstance(reason, str) \
+        else int(reason)
+    _check(_load().dds_trace_flight(int(code), int(rank)),
+           "trace_flight")
+
+
+def trace_stats() -> dict:
+    """Trace counters (:data:`TRACE_STAT_KEYS`): rings/threads/live
+    occupancy gauges plus the monotone captured/dropped/flight/span
+    totals."""
+    arr = (ctypes.c_int64 * 12)()
+    _check(_load().dds_trace_stats(arr), "trace_stats")
+    return dict(zip(TRACE_STAT_KEYS, list(arr)[:len(TRACE_STAT_KEYS)]))
+
+
+def _trace_dump_call(fn) -> np.ndarray:
+    need = int(fn(None, 0))
+    if need <= 0:
+        return np.empty(0, dtype=TRACE_EVENT_DTYPE)
+    buf = ctypes.create_string_buffer(need)
+    n = int(fn(buf, need))
+    events = np.frombuffer(buf.raw[:n], dtype=TRACE_EVENT_DTYPE).copy()
+    # Chronological merge across the per-thread rings.
+    return events[np.argsort(events["t_ns"], kind="stable")]
+
+
+def trace_dump() -> np.ndarray:
+    """Every live ring event of this process as a structured array
+    (:data:`TRACE_EVENT_DTYPE`), time-sorted across threads. Bounded by
+    the rings' capacity; empty when tracing never ran."""
+    return _trace_dump_call(_load().dds_trace_dump)
+
+
+def trace_flight_dump() -> np.ndarray:
+    """The LAST flight-recorder snapshot (same format as
+    :func:`trace_dump`, ending in its ``flight`` marker event)."""
+    return _trace_dump_call(_load().dds_trace_flight_dump)
 
 
 #: dict keys of :meth:`NativeStore.lane_state`, in native layout order.

@@ -171,6 +171,15 @@ class DeviceLoader:
             # proves its QoS behavior from the record alone. Inert
             # (empty) on single-tenant stores.
             self.metrics.set_tenant_source(store.tenant_stats)
+        if store is not None and hasattr(store, "trace_summary"):
+            # ddtrace: summary()["trace"] carries this epoch's event
+            # captures/drops, flight-recorder activity and measured
+            # span-latency percentiles whenever tracing is on (inert —
+            # and absent from the summary — while it is off). The
+            # begin snapshot uses the cheap counters-only source.
+            self.metrics.set_trace_source(
+                store.trace_summary,
+                getattr(store, "trace_stats", None))
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether

@@ -42,7 +42,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..binding import (DEFAULT_OP_DEADLINE_S, ERR_PEER_LOST,
-                       ERR_TRANSPORT, DDStoreError)
+                       ERR_TRANSPORT, DDStoreError, trace_emit,
+                       trace_enabled, trace_flight, trace_new_span)
 
 __all__ = ["WindowPlan", "plan_window", "plan_epoch_windows",
            "EpochReadahead"]
@@ -152,11 +153,14 @@ def plan_epoch_windows(row_starts, batches: Iterable,
 
 class _Window:
     __slots__ = ("plan", "slot", "handles", "bufs", "ragged", "futures",
-                 "delivered", "ready", "ready_mu", "t_issue")
+                 "delivered", "ready", "ready_mu", "t_issue", "span",
+                 "wnum")
 
     def __init__(self, plan: WindowPlan, slot: int):
         self.plan = plan
         self.slot = slot
+        self.span = 0   # ddtrace span id of this window (0 = untraced)
+        self.wnum = 0   # global window number
         self.handles: Dict[str, object] = {}   # var -> AsyncBatchRead
         self.bufs: Dict[str, np.ndarray] = {}  # var -> staged view
         self.futures: Dict[str, object] = {}   # var -> Future (ragged)
@@ -347,6 +351,16 @@ class EpochReadahead:
                         f"readahead window {w} needs {n} staging rows "
                         f"but the ring was sized for {self._max_rows} "
                         f"(batches grew mid-epoch?)")
+                win.wnum = w
+                if trace_enabled():
+                    # ddtrace: one span per window — issue/ready/stall
+                    # events group under it in the merged trace, next
+                    # to the native async-read spans its fetches mint.
+                    rank = int(getattr(self.store, "rank", -1))
+                    win.span = trace_new_span(rank)
+                    trace_emit("window_issue", win.span, rank, w, n,
+                               sum(n * rb
+                                   for rb in self._row_bytes.values()))
                 win.t_issue = time.monotonic()
                 for v in self._vars:
                     if self._ragged[v]:
@@ -486,6 +500,15 @@ class EpochReadahead:
                             if set_deadline is not None:
                                 set_deadline(0.0)
                 except DDStoreError as e2:
+                    # Window give-up: the bulk fetch AND its per-batch
+                    # refetch both failed — snapshot every thread's
+                    # last events before surfacing (the native layer
+                    # already snapshotted on a surfaced kErrPeerLost;
+                    # this covers the plain-transport give-up too).
+                    if trace_enabled():
+                        trace_flight("window_giveup",
+                                     int(getattr(self.store, "rank",
+                                                 -1)))
                     with self._mu:
                         self._error = e2
                         self._cond.notify_all()
@@ -565,9 +588,16 @@ class EpochReadahead:
 
     def _account(self, win: _Window, stall_s: float, idle_s: float,
                  fetch_s: float) -> None:
+        wbytes = sum(int(win.plan.rows.size) * rb
+                     for rb in self._row_bytes.values())
+        if win.span:
+            rank = int(getattr(self.store, "rank", -1))
+            trace_emit("window_ready", win.span, rank, win.wnum,
+                       wbytes, int(fetch_s * 1e6))
+            if stall_s > 1e-4:
+                trace_emit("window_stall", win.span, rank, win.wnum, 0,
+                           int(stall_s * 1e6))
         if self.sched is not None and fetch_s > 0.0:
-            wbytes = sum(int(win.plan.rows.size) * rb
-                         for rb in self._row_bytes.values())
             self.sched.observe_window(wbytes, fetch_s,
                                       cold=self._windows_fed == 0)
             self._windows_fed += 1

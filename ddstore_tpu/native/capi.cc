@@ -15,6 +15,7 @@
 #include "local_transport.h"
 #include "store.h"
 #include "tcp_transport.h"
+#include "trace.h"
 
 using dds::Store;
 
@@ -491,6 +492,69 @@ int dds_fault_stats(dds_handle* h, int64_t out[16]) {
   for (int i = 0; i < 6; ++i) out[6 + i] = st[i] + tc[i];
   out[12] = tc[6] >= 0 ? tc[6] : st[6];
   return dds::kOk;
+}
+
+// -- ddtrace: event-ring tracing + flight recorder ----------------------------
+//
+// Process-global (like the fault injector): the rings belong to
+// threads, not stores, and a ThreadGroup test's N in-process "ranks"
+// share one trace — every event carries its emitting rank.
+
+// Runtime switch: enabled >= 0 sets (0/1; -1 keeps), ring_events >= 1
+// sets the per-thread ring capacity for rings allocated from now on.
+int dds_trace_configure(int enabled, long ring_events) {
+  return dds::trace::Configure(enabled, ring_events);
+}
+
+int dds_trace_enabled(void) { return dds::trace::Enabled() ? 1 : 0; }
+
+// Drop recorded events (rings trimmed, flight buffer cleared). The
+// monotone totals in dds_trace_stats keep counting.
+int dds_trace_reset(void) {
+  dds::trace::Reset();
+  return 0;
+}
+
+// Python-side event injection (readahead window issue/ready/stall,
+// scheduler replan/applied ride this). span 0 = outside any span.
+int dds_trace_emit(uint32_t type, uint64_t span, int rank, int64_t a,
+                   int64_t b, int64_t c) {
+  dds::trace::Emit(static_cast<uint16_t>(type), span, rank, a, b, c);
+  return 0;
+}
+
+// Mint a span id for a Python-side logical op (a readahead window).
+uint64_t dds_trace_new_span(int rank) {
+  return dds::trace::NewSpan(rank);
+}
+
+// Manual flight-recorder trigger (the Python readahead layer's window
+// give-up; reason codes in trace.h FlightReason / binding.py
+// TRACE_FLIGHT_REASONS).
+int dds_trace_flight(int reason, int rank) {
+  dds::trace::Flight(reason, rank);
+  return 0;
+}
+
+// Serialize ring events (packed 48-byte records, binding.py
+// TRACE_EVENT_DTYPE). out == NULL returns the worst-case byte size;
+// else returns the bytes written.
+int64_t dds_trace_dump(void* out, int64_t cap_bytes) {
+  return dds::trace::DumpEvents(out, cap_bytes);
+}
+
+// Serialize the LAST flight-recorder snapshot (same record format).
+int64_t dds_trace_flight_dump(void* out, int64_t cap_bytes) {
+  return dds::trace::DumpFlight(out, cap_bytes);
+}
+
+// Counter snapshot: [enabled, ring_events, threads, capacity, live,
+// captured, dropped, flight_events, flight_dumps, spans, 0, 0] — keep
+// in sync with binding.py TRACE_STAT_KEYS.
+int dds_trace_stats(int64_t out[12]) {
+  if (!out) return dds::kErrInvalidArg;
+  dds::trace::Stats(out);
+  return 0;
 }
 
 int dds_rank(dds_handle* h) { return h ? h->store->rank() : -1; }

@@ -7,6 +7,7 @@
 #include <thread>
 
 #include "store.h"
+#include "trace.h"
 
 namespace dds {
 
@@ -259,10 +260,14 @@ int RetryTransientLoop(RetryStats& stats, int target,
     }
     const long ms = BackoffMs(pol, att, salt);
     if (ms > 0) {
+      // Backoff is recorded BEFORE the sleep so a trace cut mid-ladder
+      // still shows the sleep that was about to happen.
+      trace::Ev(trace::kBackoff, -1, target, ms, att);
       FaultSleepMs(ms, stop);
       stats.backoff_ms.fetch_add(ms, std::memory_order_relaxed);
     }
     stats.retries.fetch_add(1, std::memory_order_relaxed);
+    trace::Ev(trace::kRetry, -1, target, att, rc);
     ++att;
     if (on_retry) on_retry();
     rc = attempt();

@@ -4,6 +4,7 @@
 #include <cstdlib>
 
 #include "fault.h"
+#include "trace.h"
 
 namespace dds {
 
@@ -71,8 +72,9 @@ void HealthMonitor::Loop() {
         if (hold > 0)
           hold = verdict_hold_[t].fetch_sub(
                      1, std::memory_order_relaxed) - 1;
-        if (hold <= 0)
-          suspected_[t].store(false, std::memory_order_relaxed);
+        if (hold <= 0 &&
+            suspected_[t].exchange(false, std::memory_order_relaxed))
+          trace::Ev(trace::kSuspectClear, rank_, t, 0, 0);
       } else {
         failures_.fetch_add(1, std::memory_order_relaxed);
         // A failure re-arms any draining verdict hold.
@@ -80,8 +82,14 @@ void HealthMonitor::Loop() {
           verdict_hold_[t].store(suspect_n_, std::memory_order_relaxed);
         const int n = fails_[t].fetch_add(1, std::memory_order_relaxed) + 1;
         if (n >= suspect_n_ &&
-            !suspected_[t].exchange(true, std::memory_order_relaxed))
+            !suspected_[t].exchange(true, std::memory_order_relaxed)) {
           raised_.fetch_add(1, std::memory_order_relaxed);
+          // Verdict moment: record it and snapshot every thread's last
+          // events — the flight recorder's "who was doing what when
+          // the peer died" story (0 = heartbeat-raised).
+          trace::Ev(trace::kSuspect, rank_, t, 0, 0);
+          trace::Flight(trace::kReasonSuspect, rank_);
+        }
       }
     }
     // Interruptible sleep (<= 50 ms slices): teardown must not wait out
@@ -100,15 +108,23 @@ void HealthMonitor::MarkSuspected(int target) {
   if (!suspected_ || target < 0 || target >= world_) return;
   verdict_hold_[target].store(suspect_n_ > 0 ? suspect_n_ : 1,
                               std::memory_order_relaxed);
-  if (!suspected_[target].exchange(true, std::memory_order_relaxed))
+  if (!suspected_[target].exchange(true, std::memory_order_relaxed)) {
     raised_.fetch_add(1, std::memory_order_relaxed);
+    // Data-path ladder verdict (1 = ladder-raised), with a flight
+    // snapshot: with replication in force kErrPeerLost never SURFACES
+    // (the read fails over) — this transition is the postmortem
+    // moment, and it runs under the failing read's span.
+    trace::Ev(trace::kSuspect, rank_, target, 1, 0);
+    trace::Flight(trace::kReasonSuspect, rank_);
+  }
 }
 
 void HealthMonitor::ResetPeer(int target) {
   if (!suspected_ || target < 0 || target >= world_) return;
   fails_[target].store(0, std::memory_order_relaxed);
   verdict_hold_[target].store(0, std::memory_order_relaxed);
-  suspected_[target].store(false, std::memory_order_relaxed);
+  if (suspected_[target].exchange(false, std::memory_order_relaxed))
+    trace::Ev(trace::kSuspectClear, rank_, target, 0, 0);
 }
 
 int HealthMonitor::SuspectFlags(int64_t* out, int cap) const {

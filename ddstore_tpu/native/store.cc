@@ -6,6 +6,7 @@
 #include <memory>
 #include <thread>
 
+#include "trace.h"
 #include "worker_pool.h"
 
 namespace dds {
@@ -373,6 +374,9 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
 
   int64_t offset = (start - shard_begin) * v.row_bytes();
   int64_t nbytes = count * v.row_bytes();
+  // Span root of this read: every transport/retry/failover event below
+  // (including the serving rank's, via the frame tag) records under it.
+  trace::ScopedOp top(rank(), trace::kClsGet, target, nbytes);
   int rc;
   if (target == rank()) {
     rc = ReadLocal(name, offset, nbytes, dst);
@@ -407,7 +411,7 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
     }
   }
   if (rc == kOk) AccountTenantRead(name, nbytes, as_tenant);
-  return rc;
+  return top.ret(rc);
 }
 
 namespace {
@@ -433,6 +437,7 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   const int64_t rb = v.row_bytes();
   const int64_t total = v.total_rows();
   char* out = static_cast<char*>(dst);
+  trace::ScopedOp top(rank(), trace::kClsGetBatch, -1, n * rb);
 
   // -- Plan -----------------------------------------------------------------
   // Sort (row, output slot) so source-adjacent rows coalesce regardless of
@@ -445,7 +450,7 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   bool presorted = true;
   for (int64_t i = 0; i < n; ++i) {
     const int64_t row = starts[i];
-    if (row < 0 || row >= total) return kErrOutOfRange;
+    if (row < 0 || row >= total) return top.ret(kErrOutOfRange);
     presorted = presorted && (i == 0 || row >= starts[i - 1]);
     order.emplace_back(row, i);
   }
@@ -573,7 +578,7 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
     } else {
       local_rc = ReadLocalV(name, local_ops.data(),
                             static_cast<int64_t>(local_ops.size()));
-      if (local_rc != kOk) return local_rc;
+      if (local_rc != kOk) return top.ret(local_rc);
     }
   }
   if (!by_peer.empty()) {
@@ -588,11 +593,11 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
     int rc = RemoteRead(name, by_peer, as_tenant);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
-      return rc;
+      return top.ret(rc);
     }
   }
   if (local_group) local_group->Wait();
-  if (local_rc != kOk) return local_rc;
+  if (local_rc != kOk) return top.ret(local_rc);
 
   // -- Scatter + replicate --------------------------------------------------
   for (const auto& fx : fixups) {
@@ -604,7 +609,7 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   for (const Replica& rep : replicas)
     std::memcpy(out + rep.dst_slot * rb, out + rep.src_slot * rb, rb);
   AccountTenantRead(name, n * rb, as_tenant);
-  return kOk;
+  return top.ret(kOk);
 }
 
 PlanStats Store::plan_stats() const {
@@ -884,15 +889,27 @@ int Store::SetTenantShare(const std::string& tenant, int share) {
 }
 
 int Store::TenantReserve(const std::string& tenant, int64_t bytes) {
-  std::lock_guard<std::mutex> lock(tenants_mu_);
-  TenantState& t = tenants_[tenant];
-  if ((t.quota_bytes >= 0 && t.bytes + bytes > t.quota_bytes) ||
-      (t.quota_vars >= 0 && t.vars + 1 > t.quota_vars)) {
-    ++t.quota_rejections;
+  bool rejected = false;
+  {
+    std::lock_guard<std::mutex> lock(tenants_mu_);
+    TenantState& t = tenants_[tenant];
+    if ((t.quota_bytes >= 0 && t.bytes + bytes > t.quota_bytes) ||
+        (t.quota_vars >= 0 && t.vars + 1 > t.quota_vars)) {
+      ++t.quota_rejections;
+      rejected = true;
+    } else {
+      t.bytes += bytes;
+      ++t.vars;
+    }
+  }
+  if (rejected) {
+    // Traced OUTSIDE tenants_mu_ (a leaf DDS_NO_BLOCKING mutex must
+    // never nest the trace registry's). An admission refusal is one of
+    // the flight recorder's trigger moments.
+    trace::Ev(trace::kQuotaReject, rank(), bytes, 0, 0);
+    trace::Flight(trace::kReasonQuota, rank());
     return kErrQuota;
   }
-  t.bytes += bytes;
-  ++t.vars;
   return kOk;
 }
 
@@ -1266,6 +1283,10 @@ int Store::ReadViaReplica(const std::string& name, int owner,
       failover_.runs.fetch_add(static_cast<int64_t>(ops.size()),
                                std::memory_order_relaxed);
       failover_.bytes.fetch_add(bytes, std::memory_order_relaxed);
+      // Replica-rerouted op, under the read's span: the dead owner and
+      // the holder that served instead, for the postmortem span tree.
+      trace::Ev(trace::kFailover, rank(), owner, h,
+                static_cast<int64_t>(ops.size()));
       return kOk;
     }
     return rc;  // fatal (out-of-range against the mirror, ...)
@@ -1459,10 +1480,27 @@ int64_t Store::GetBatchAsync(const std::string& name, void* dst,
   std::vector<int64_t> idx(starts, starts + n);
   const std::string tenant =
       as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
-  return SubmitAsync(tenant,
-                     [this, name, dst, tenant, idx = std::move(idx)]() {
-    return GetBatch(name, dst, idx.data(),
-                    static_cast<int64_t>(idx.size()), tenant);
+  // Span minted at ISSUE time, carried into the pool body: the op's
+  // begin→end brackets issue→completion (the readahead overlap the
+  // trace exists to show); the inner GetBatch joins the same span.
+  uint64_t tspan = 0;
+  int64_t tbytes = 0;
+  if (trace::Enabled()) {
+    VarInfo v;
+    tbytes = GetVarInfo(name, &v) ? n * v.row_bytes() : 0;
+    tspan = trace::NewSpan(rank());
+    trace::Emit(trace::kOpBegin, tspan, rank(), trace::kClsAsyncBatch,
+                -1, tbytes);
+  }
+  return SubmitAsync(tenant, [this, name, dst, tenant, tspan, tbytes,
+                              idx = std::move(idx)]() {
+    trace::ScopedSpan sp(tspan);
+    int rc = GetBatch(name, dst, idx.data(),
+                      static_cast<int64_t>(idx.size()), tenant);
+    if (tspan)
+      trace::Emit(trace::kOpEnd, tspan, rank(), trace::kClsAsyncBatch,
+                  rc, tbytes);
+    return rc;
   });
 }
 
@@ -1480,12 +1518,28 @@ int64_t Store::ReadRunsAsync(const std::string& name, void* dst,
   std::vector<int64_t> nb(nbytes, nbytes + nruns);
   const std::string tenant =
       as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
+  // Issue-time async pair (kClsAsyncBatch, like GetBatchAsync): its
+  // begin→end brackets issue→completion; the inner ReadRuns ScopedOp
+  // tags the execution leg as kClsReadRuns under the same span.
+  uint64_t tspan = 0;
+  int64_t total = 0;
+  if (trace::Enabled()) {
+    for (int64_t i = 0; i < nruns; ++i) total += nbytes[i];
+    tspan = trace::NewSpan(rank());
+    trace::Emit(trace::kOpBegin, tspan, rank(), trace::kClsAsyncBatch,
+                -1, total);
+  }
   return SubmitAsync(tenant,
-                     [this, name, dst, tenant, t = std::move(t),
-                      so = std::move(so), dof = std::move(dof),
-                      nb = std::move(nb)]() {
-    return ReadRuns(name, static_cast<char*>(dst), t, so, dof, nb,
-                    tenant);
+                     [this, name, dst, tenant, tspan, total,
+                      t = std::move(t), so = std::move(so),
+                      dof = std::move(dof), nb = std::move(nb)]() {
+    trace::ScopedSpan sp(tspan);
+    int rc = ReadRuns(name, static_cast<char*>(dst), t, so, dof, nb,
+                      tenant);
+    if (tspan)
+      trace::Emit(trace::kOpEnd, tspan, rank(), trace::kClsAsyncBatch,
+                  rc, total);
+    return rc;
   });
 }
 
@@ -1498,12 +1552,18 @@ int Store::ReadRuns(const std::string& name, char* dst,
   VarInfo v;
   if (!GetVarInfo(name, &v)) return kErrNotFound;
   const int64_t nruns = static_cast<int64_t>(targets.size());
+  int64_t total_bytes = 0;
+  for (int64_t nb : nbytes) total_bytes += nb;
+  // Joins the issue-time span (ReadRunsAsync set it on this pool
+  // thread); begin→end here is the execution leg, and a surfaced
+  // kErrPeerLost triggers the flight recorder from the dtor.
+  trace::ScopedOp top(rank(), trace::kClsReadRuns, -1, total_bytes);
   std::vector<ReadOp> local_ops;
   std::map<int, std::vector<ReadOp>> by_peer;
   for (int64_t i = 0; i < nruns; ++i) {
     if (targets[i] < 0 || targets[i] >= world() || nbytes[i] < 0 ||
         dst_off[i] < 0)
-      return kErrInvalidArg;
+      return top.ret(kErrInvalidArg);
     ReadOp op{src_off[i], nbytes[i], dst + dst_off[i]};
     if (targets[i] == rank()) {
       local_ops.push_back(op);
@@ -1529,23 +1589,20 @@ int Store::ReadRuns(const std::string& name, char* dst,
     } else {
       local_rc = ReadLocalV(name, local_ops.data(),
                             static_cast<int64_t>(local_ops.size()));
-      if (local_rc != kOk) return local_rc;
+      if (local_rc != kOk) return top.ret(local_rc);
     }
   }
   if (!by_peer.empty()) {
     int rc = RemoteRead(name, by_peer, as_tenant);
     if (rc != kOk) {
       if (local_group) local_group->Wait();
-      return rc;
+      return top.ret(rc);
     }
   }
   if (local_group) local_group->Wait();
-  if (local_rc == kOk) {
-    int64_t total = 0;
-    for (int64_t nb : nbytes) total += nb;
-    AccountTenantRead(name, total, as_tenant);
-  }
-  return local_rc;
+  if (local_rc == kOk)
+    AccountTenantRead(name, total_bytes, as_tenant);
+  return top.ret(local_rc);
 }
 
 int Store::AsyncWait(int64_t ticket, int64_t timeout_ms,

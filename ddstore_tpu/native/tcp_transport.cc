@@ -20,6 +20,8 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "trace.h"
+
 namespace dds {
 namespace {
 
@@ -833,6 +835,13 @@ void TcpTransport::HandleConnection(int fd) {
       } else if (bad || total != req.nbytes) {
         resp.status = kErrInvalidArg;
       } else {
+        // Serving leg recorded under the REQUESTER's span (frame tag):
+        // the one-sided read's other half finally holds its side of
+        // the story. req.tag is 0 when the requester traced nothing.
+        if (req.tag != 0)
+          trace::Emit(trace::kServeBegin,
+                      static_cast<uint64_t>(req.tag), rank_, req.src,
+                      nops, total);
         bool conn_dead = false;
         int rc = store_->WithShard(
             name, [&](const char* base, int64_t sb) {
@@ -878,6 +887,10 @@ void TcpTransport::HandleConnection(int fd) {
                 conn_dead = true;
               return kOk;
             });
+        if (req.tag != 0)
+          trace::Emit(trace::kServeEnd,
+                      static_cast<uint64_t>(req.tag), rank_, req.src,
+                      conn_dead ? kErrTransport : rc, total);
         if (conn_dead) return;
         if (rc == kOk) {  // header + payload already sent
           // Tenant serve ledger: the op frame's variable name IS the
@@ -899,6 +912,9 @@ void TcpTransport::HandleConnection(int fd) {
     if (!store_) {
       resp.status = kErrNotFound;
     } else {
+      if (req.tag != 0)
+        trace::Emit(trace::kServeBegin, static_cast<uint64_t>(req.tag),
+                    rank_, req.src, 1, req.nbytes);
       bool conn_dead = false;
       int rc = store_->WithShard(
           name, [&](const char* base, int64_t sb) {
@@ -913,6 +929,10 @@ void TcpTransport::HandleConnection(int fd) {
             if (SendIov(fd, iov, 2, send_deadline()) != 0) conn_dead = true;
             return kOk;
           });
+      if (req.tag != 0)
+        trace::Emit(trace::kServeEnd, static_cast<uint64_t>(req.tag),
+                    rank_, req.src,
+                    conn_dead ? kErrTransport : rc, req.nbytes);
       if (conn_dead) return;
       if (rc == kOk) {  // header + payload already sent
         store_->AccountTenantServe(name, req.nbytes);
@@ -955,6 +975,7 @@ int TcpTransport::EnsureConnected(Peer& p, Conn& c) {
         c.fd = ufd;
         dials_.fetch_add(1, std::memory_order_relaxed);
         uds_conns_.fetch_add(1, std::memory_order_relaxed);
+        trace::Ev(trace::kLaneDial, rank_, c.idx, 1, 0);
         return kOk;
       }
       ::close(ufd);
@@ -1030,6 +1051,7 @@ int TcpTransport::EnsureConnected(Peer& p, Conn& c) {
   ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   c.fd = fd;
   dials_.fetch_add(1, std::memory_order_relaxed);
+  trace::Ev(trace::kLaneDial, rank_, c.idx, 0, 0);
   return kOk;
 }
 
@@ -1216,10 +1238,18 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
   if (rc != kOk) return rc;
 
   auto fail = [&]() {
+    trace::Ev(trace::kLaneClose, rank_, c.idx, kErrTransport, 0);
     ::close(c.fd);
     c.fd = -1;
     return kErrTransport;
   };
+
+  // Cross-rank span propagation: the requester's active span rides the
+  // frame's `tag` field — RESERVED (always 0) on data reads until now,
+  // so with tracing off the frames below are byte-identical to the
+  // untraced tree (pinned by tests/test_trace.py). The serving rank
+  // records its streaming leg under this id (see HandleConnection).
+  const int64_t tspan = static_cast<int64_t>(trace::CurrentSpan());
 
   // Greedy framing: consecutive ops share a vectored frame up to the
   // op-count (IOV_MAX) and byte caps; a lone op — including one bigger
@@ -1268,13 +1298,13 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
           WireReq{kMagic, kOpRead,
                   rank_,  static_cast<uint32_t>(name.size()),
                   ops[fr.begin].offset, ops[fr.begin].nbytes,
-                  0};
+                  tspan};
     else
       hdrs[static_cast<size_t>(f)] =
           WireReq{kMagic, kOpReadVec,
                   rank_,  static_cast<uint32_t>(name.size()),
                   fn,     fr.bytes,
-                  0};
+                  tspan};
   }
   std::vector<iovec> req_iovs;  // reused request gather list
   std::vector<iovec> iovs;      // reused scatter list
@@ -1949,6 +1979,8 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
         for (int r : t.results) ok = ok && r == kOk;
         if (ok) {
           cma_ops_.fetch_add(t.rq->n, std::memory_order_relaxed);
+          trace::Ev(trace::kCmaRead, rank_, t.rq->target, t.rq->n,
+                    t.bytes);
           cma_ok_bytes += t.bytes;
           cma_any_bulk = cma_any_bulk || t.bytes >= kBulkBytes;
           // Scatter-class = a SINGLE request with >= kScatterMinOps ops
@@ -2060,7 +2092,11 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   uint64_t lane_rot = 0;
   const int budget = TenantLaneBudget(name, &lane_rot, as_tenant);
   const bool budget_capped = budget > 0 && budget < stripe_lanes;
-  if (budget_capped) stripe_lanes = budget;
+  if (budget_capped) {
+    stripe_lanes = budget;
+    trace::Ev(trace::kLaneBudgetRotate, rank_, budget,
+              static_cast<int64_t>(lane_rot), 0);
+  }
   const bool lane_sample = lane_bulk || lane_scatter;
 
   // Pass 2 — build the peer × lane leaves. Fan out across the lane set

@@ -1,5 +1,7 @@
 #include "worker_pool.h"
 
+#include "trace.h"
+
 namespace dds {
 
 WorkerPool::WorkerPool(int max_threads)
@@ -73,6 +75,10 @@ void TaskGroup::Launch(std::function<void()> fn) {
     std::lock_guard<std::mutex> lock(state_->mu);
     ++state_->pending;
   }
+  // Trace-span propagation: a leaf runs under the SUBMITTER's span so
+  // lane dials, retries and serve legs attribute to the op that caused
+  // them. Identity (one relaxed load) when tracing is off.
+  fn = trace::TraceTask(std::move(fn));
   pool_->Submit([st = state_, fn = std::move(fn)]() {
     fn();
     // notify under the lock: the waiter can destroy the TaskGroup the
@@ -91,7 +97,8 @@ void TaskGroup::LaunchMany(std::vector<std::function<void()>> fns) {
   std::vector<std::function<void()>> wrapped;
   wrapped.reserve(fns.size());
   for (auto& fn : fns)
-    wrapped.emplace_back([st = state_, fn = std::move(fn)]() {
+    wrapped.emplace_back([st = state_,
+                          fn = trace::TraceTask(std::move(fn))]() {
       fn();
       std::lock_guard<std::mutex> lock(st->mu);
       if (--st->pending == 0) st->cv.notify_all();

@@ -1,0 +1,61 @@
+"""ddtrace CLI: merge per-rank dumps, render postmortem span trees.
+
+Workflow (README "Distributed tracing & flight recorder")::
+
+    # each rank saves its dump (live rings or the flight snapshot)
+    from ddstore_tpu import obs
+    obs.save_dump(f"/tmp/trace.r{store.rank}.npy", store.trace_dump())
+
+    # merge into Chrome trace-event JSON (chrome://tracing / Perfetto)
+    python -m ddstore_tpu.obs merge -o trace.json /tmp/trace.r*.npy
+
+    # or read the story in the terminal
+    python -m ddstore_tpu.obs tree /tmp/trace.r*.npy
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import chrome_trace, load_dump, merge, span_tree
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ddstore_tpu.obs",
+        description="Merge/render ddstore trace dumps.")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser(
+        "merge", help="merge per-rank .npy dumps into Chrome "
+        "trace-event JSON (chrome://tracing, Perfetto)")
+    mp.add_argument("dumps", nargs="+", help="per-rank dump .npy files")
+    mp.add_argument("-o", "--out", default="-",
+                    help="output path (default stdout)")
+    tp = sub.add_parser(
+        "tree", help="render the merged span tree as text "
+        "(postmortems over a flight dump)")
+    tp.add_argument("dumps", nargs="+")
+    tp.add_argument("--span", type=lambda s: int(s, 16), default=None,
+                    help="render one span only (hex id)")
+    args = ap.parse_args(argv)
+
+    events = merge([load_dump(p) for p in args.dumps])
+    if args.cmd == "merge":
+        payload = json.dumps(chrome_trace(events))
+        if args.out == "-":
+            print(payload)
+        else:
+            with open(args.out, "w") as f:
+                f.write(payload)
+            print(f"# {len(events)} events -> {args.out}",
+                  file=sys.stderr)
+    else:
+        print(span_tree(events, span=args.span))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

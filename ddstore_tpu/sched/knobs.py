@@ -130,6 +130,17 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
             "is share-split (each tenant runs at most max(1, width * "
             "share / total) concurrent async reads) and the scheduler "
             "plans matching per-tenant lane budgets"),
+    _k("DDSTORE_TRACE", "config",
+       desc="1 enables the ddtrace event rings at load (default off: "
+            "one relaxed load per instrumentation site, frames "
+            "byte-identical to the untraced tree)"),
+    _k("DDSTORE_TRACE_FLIGHT", "config",
+       desc="flight-recorder snapshot bound in events (default 16384)"),
+    _k("DDSTORE_TRACE_PHASE_TIMEOUT_S", "config",
+       desc="bench trace-phase subprocess cap, default 300"),
+    _k("DDSTORE_TRACE_RING", "config",
+       desc="per-thread trace ring capacity in events (default 4096); "
+            "overflow overwrites oldest and counts a drop"),
     _k("DDSTORE_UDS", "config"),
     _k("DDSTORE_WORLD", "config"),
 ]}

@@ -78,6 +78,7 @@ import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..binding import trace_emit, trace_enabled
 from .knobs import pinned_knobs
 from .measure import WARM_MIN_SAMPLES, SampleSet
 
@@ -427,6 +428,14 @@ class Scheduler:
         if not self.enabled:
             return self._plan
         with self._replan_mu:
+            # ddtrace: the replan + its applied plan, next to the
+            # transport events that motivated it.
+            traced = trace_enabled()
+            rank = -1
+            if traced:
+                if self.store is not None:
+                    rank = int(getattr(self.store, "rank", -1) or 0)
+                trace_emit("plan_replan", 0, rank, self.replans + 1)
             plan = self.apply(self.compute())
             plan.reason = reason
             with self._mu:
@@ -434,6 +443,10 @@ class Scheduler:
                 self.replans += 1
                 if len(self.reasons) < 64:
                     self.reasons.append(reason)
+            if traced:
+                trace_emit("plan_applied", 0, rank, self.replans,
+                           int(bool(plan.engaged)),
+                           int(plan.depth or 0))
         return plan
 
     # -- triggers ----------------------------------------------------------

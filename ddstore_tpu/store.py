@@ -757,6 +757,49 @@ class DDStore:
         wires this in as ``summary()["faults"]``."""
         return self._native.fault_stats()
 
+    # -- ddtrace: event rings, spans, flight recorder ----------------------
+    #
+    # Process-global (rings belong to threads; in-process ThreadGroup
+    # "ranks" share one trace — every event carries its rank), default
+    # OFF with a one-relaxed-load off state. DDSTORE_TRACE=1 or
+    # binding.trace_configure(1) turns recording on.
+
+    def trace_dump(self):
+        """Every live trace event of this process as a structured
+        numpy array (``binding.TRACE_EVENT_DTYPE``), time-sorted.
+        Feed per-rank dumps to ``python -m ddstore_tpu.obs merge`` for
+        Chrome trace-event JSON, or ``obs.span_tree`` for text."""
+        from . import binding
+
+        return binding.trace_dump()
+
+    def trace_flight_dump(self):
+        """The last flight-recorder snapshot (taken automatically when
+        ``kErrPeerLost``/``kErrQuota`` surfaces, a suspect verdict
+        lands, or the readahead layer gives up on a window)."""
+        from . import binding
+
+        return binding.trace_flight_dump()
+
+    def trace_stats(self) -> dict:
+        """Trace counters (``binding.TRACE_STAT_KEYS``): ring/thread
+        gauges + monotone captured/dropped/flight/span totals."""
+        from . import binding
+
+        return binding.trace_stats()
+
+    def trace_summary(self) -> dict:
+        """The ``summary()["trace"]`` payload: counters, ring
+        occupancy, and (while tracing) measured span-latency p50/p99
+        per (op class, route, peer) from the ring data.
+        ``DeviceLoader.metrics`` wires this in automatically."""
+        from . import binding
+        from .obs import trace_summary
+
+        st = binding.trace_stats()
+        events = binding.trace_dump() if st.get("enabled") else None
+        return trace_summary(st, events)
+
     # -- replication / failover / health ----------------------------------
 
     @property

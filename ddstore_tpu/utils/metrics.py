@@ -196,6 +196,13 @@ class PipelineMetrics:
         self._tenant_source: Optional[Callable[[], Dict]] = None
         self._tenant_begin: Optional[Dict] = None
         self._tenant_end: Optional[Dict] = None
+        # ddtrace source (DDStore.trace_summary): summary()["trace"]
+        # carries per-epoch captured/dropped/flight deltas plus the
+        # measured span-latency percentiles while tracing is on.
+        self._trace_source: Optional[Callable[[], Dict]] = None
+        self._trace_counters_source: Optional[Callable[[], Dict]] = None
+        self._trace_begin: Optional[Dict] = None
+        self._trace_end: Optional[Dict] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -341,6 +348,56 @@ class PipelineMetrics:
             out[tenant] = trow
         return out
 
+    #: gauge keys of the trace source (reported raw, never delta'd —
+    #: keep in sync with binding.TRACE_STAT_KEYS's gauge subset plus
+    #: the derived ring_occupancy); "span_latency" (a dict) also
+    #: passes through live.
+    TRACE_GAUGES = ("enabled", "ring_events", "threads", "capacity",
+                    "live", "ring_occupancy", "flight_events")
+
+    def set_trace_source(self, source: Optional[Callable[[], Dict]],
+                         counters_source: Optional[Callable[[], Dict]]
+                         = None) -> None:
+        """Attach a zero-arg callable returning the ddtrace payload
+        (``DDStore.trace_summary`` — monotone captured/dropped/flight/
+        span counters + ring gauges + measured span-latency
+        percentiles). Snapshotted at epoch boundaries;
+        ``summary()["trace"]`` reports per-epoch counter deltas with
+        the gauges and percentile table live. ``counters_source``, when
+        given (``DDStore.trace_stats``), is used for the BEGIN
+        snapshot: it only needs the counter scalars, and the full
+        source's ring dump + percentile pass would run per epoch start
+        for nothing."""
+        self._trace_source = source
+        self._trace_counters_source = counters_source or source
+
+    def _snap_trace(self, begin: bool = False) -> Optional[Dict]:
+        src = self._trace_counters_source if begin else self._trace_source
+        if src is None:
+            return None
+        try:
+            return dict(src())
+        except Exception:
+            return None
+
+    def trace_summary(self) -> Dict:
+        """Per-epoch trace view: events captured/dropped this epoch,
+        flight-recorder activity, ring occupancy, and the measured
+        per-(class, route, peer) span latency percentiles."""
+        out: Dict = {}
+        if self._trace_begin is None:
+            return out
+        end = self._trace_end if self._trace_end is not None \
+            else self._snap_trace()
+        if end is None:
+            return out
+        for k, v in end.items():
+            if k in self.TRACE_GAUGES or k == "span_latency":
+                out[k] = v
+            else:
+                out[k] = max(0, int(v) - int(self._trace_begin.get(k, 0)))
+        return out
+
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
             -> None:
         """Attach a zero-arg callable returning the cost-model
@@ -480,6 +537,8 @@ class PipelineMetrics:
         self._failover_end = None
         self._tenant_begin = self._snap_tenants()
         self._tenant_end = None
+        self._trace_begin = self._snap_trace(begin=True)
+        self._trace_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -500,6 +559,7 @@ class PipelineMetrics:
         self._fault_end = self._snap_faults()
         self._failover_end = self._snap_failover()
         self._tenant_end = self._snap_tenants()
+        self._trace_end = self._snap_trace()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -561,6 +621,12 @@ class PipelineMetrics:
                    any(v for k, v in tn.get("", {}).items()
                        if k not in self.TENANT_GAUGES)):
             out["tenants"] = tn
+        tr = self.trace_summary()
+        # Included while tracing records (the whole payload is the
+        # result a trace A/B reads) or if anything was captured this
+        # epoch; untraced epochs stay byte-identical.
+        if tr and (tr.get("enabled") or tr.get("captured", 0)):
+            out["trace"] = tr
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself
