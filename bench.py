@@ -855,6 +855,184 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
     return out
 
 
+def integrity_bench(world=4, num=8192, dim=64, batch=256, pairs=4,
+                    victim=1):
+    """Integrity A/B (ISSUE 11 acceptance): a 4-owner ThreadGroup TCP
+    store at R=2 with per-row checksums.
+
+    (a) ORACLE BYTE-IDENTITY under injected corruption at ONE serving
+        rank: corrupt:1.0 armed for `victim`'s serve path, rank 0 reads
+        scattered batches spanning every owner — each delivered batch
+        must equal the locally reconstructed per-rank-seeded oracle
+        (detected >= injections at the reader, verify_failovers > 0 =
+        the replica rung actually served, 0 give-ups, 0 kErrCorrupt).
+    (b) SCRUB REPAIR: a second variable is registered WHILE the
+        injector corrupts the victim's serves, so the victim's mirror
+        fills corrupt; after disarming, scrub_once() must detect the
+        divergence and re-pull it clean (second pass finds nothing).
+    (c) VERIFY-ON OVERHEAD: interleaved off/on scatter epochs without
+        injection; the median on/off wall ratio is reported and gated
+        loosely (hashing every delivered byte + the one-shot table
+        fetch are real work; this box's CPU noise is documented ±3x).
+
+    CMA off: the corrupt arm lives in the TCP serve loop (and the
+    local transport), and the oracle must exercise the wire path."""
+    import threading
+    import uuid
+
+    import numpy as np
+
+    from ddstore_tpu import DDStore, ThreadGroup, fault_configure
+
+    env = {"DDSTORE_CMA": "0", "DDSTORE_REPLICATION": "2",
+           "DDSTORE_HEARTBEAT_MS": "0", "DDSTORE_RETRY_MAX": "4",
+           "DDSTORE_RETRY_BASE_MS": "2", "DDSTORE_OP_DEADLINE_S": "60"}
+    backup = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    out = {}
+    errors = []
+    name = uuid.uuid4().hex
+    try:
+        def run_rank(rank):
+            g = ThreadGroup(name, rank, world)
+            # Per-rank-seeded shards: identical shards would hide
+            # wrong-peer serving (the lanes-phase lesson).
+            rng = np.random.default_rng(100 + rank)
+            data = rng.standard_normal((num, dim)).astype(np.float32)
+            with DDStore(g, backend="tcp") as s:
+                s.add("v", data)
+                # (b) setup: the scrub variable registers while the
+                # victim's serves corrupt — its mirror fills corrupt.
+                # Verification must be OFF here or the verified
+                # FillMirror would refuse the bad fill.
+                if rank == 0:
+                    fault_configure("corrupt:1.0", 77, ranks=[victim])
+                s.barrier()
+                sdata = np.random.default_rng(200 + rank) \
+                    .standard_normal((num // 8, dim)).astype(np.float32)
+                s.add("scrubv", sdata)
+                s.barrier()
+                if rank == 0:
+                    fault_configure("", 0)
+                s.barrier()
+                # Everything below runs verified.
+                s.integrity_configure(verify=1)
+                s.barrier()
+                if rank == 0:
+                    # (b) scrub: rank 0 hosts the victim's mirror
+                    # (chain holder of owner v = rank v-1).
+                    ist0 = s.integrity_stats()
+                    divergent = s.scrub_once()
+                    ist1 = s.integrity_stats()
+                    clean_after = s.scrub_once()
+                    out.update({
+                        "integrity_scrub_divergent": divergent,
+                        "integrity_scrub_repaired":
+                            ist1["scrub_repaired"]
+                            - ist0["scrub_repaired"],
+                        "integrity_scrub_clean_after": clean_after,
+                    })
+                    # (a) oracle identity under injected corruption.
+                    full = np.concatenate([
+                        np.random.default_rng(100 + r)
+                        .standard_normal((num, dim)).astype(np.float32)
+                        for r in range(world)])
+                    idx_rng = np.random.default_rng(7)
+                    fs0 = s.fault_stats()
+                    is0 = s.integrity_stats()
+                    fault_configure("corrupt:1.0", 99, ranks=[victim])
+                    try:
+                        nb = 0
+                        for _ in range(16):
+                            idx = idx_rng.integers(0, world * num,
+                                                   size=batch)
+                            got = s.get_batch("v", idx)
+                            np.testing.assert_array_equal(got, full[idx])
+                            nb += 1
+                        fs = s.fault_stats()
+                        ist = s.integrity_stats()
+                    finally:
+                        fault_configure("", 0)
+                    injected = fs["injected_corrupt"] \
+                        - fs0["injected_corrupt"]
+                    detected = ist["verify_mismatches"] \
+                        - is0["verify_mismatches"]
+                    out.update({
+                        "integrity_batches": nb,
+                        "integrity_injected": injected,
+                        "integrity_detected": detected,
+                        "integrity_failovers": ist["verify_failovers"]
+                        - is0["verify_failovers"],
+                        "integrity_giveups": fs["retry_giveups"]
+                        - fs0["retry_giveups"],
+                        "integrity_corrupt_errors": ist["corrupt_errors"]
+                        - is0["corrupt_errors"],
+                    })
+                    # (c) overhead: interleaved off/on pairs, median.
+                    ratios = []
+                    oidx = [idx_rng.integers(0, world * num, size=batch)
+                            for _ in range(8)]
+
+                    def sweep():
+                        t0 = time.perf_counter()
+                        for ix in oidx:
+                            s.get_batch("v", ix)
+                        return time.perf_counter() - t0
+                    sweep()  # warm both paths' lanes once
+                    for _ in range(pairs):
+                        s.integrity_configure(verify=0)
+                        t_off = sweep()
+                        s.integrity_configure(verify=1)
+                        t_on = sweep()
+                        if t_off > 0:
+                            ratios.append(t_on / t_off)
+                    overhead = sorted(ratios)[len(ratios) // 2] \
+                        if ratios else 0.0
+                    out.update({
+                        "integrity_overhead_x": round(overhead, 3),
+                        # Gates: oracle identity asserted above;
+                        # corruption both provoked and absorbed via the
+                        # replica rung; the scrubber found and repaired
+                        # the bad mirror; overhead within a loose bound
+                        # (±3x CPU noise documented on this box).
+                        "integrity_ok": bool(
+                            injected > 0 and detected > 0
+                            and out["integrity_failovers"] > 0
+                            and out["integrity_giveups"] == 0
+                            and out["integrity_corrupt_errors"] == 0
+                            and out["integrity_scrub_divergent"] >= 1
+                            and out["integrity_scrub_repaired"] >= 1
+                            and out["integrity_scrub_clean_after"] == 0
+                            and overhead <= 3.0),
+                    })
+                s.barrier()
+
+        def body(rank):
+            try:
+                run_rank(rank)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(280)
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("integrity_bench rank thread hung past "
+                               "its 280 s join")
+    finally:
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def trace_bench(world=4, num=16384, dim=64, batch=256, pairs=5):
     """ddtrace A/B (ISSUE 10 acceptance): the 4-owner ThreadGroup TCP
     scatter workload runs INTERLEAVED off/on pairs — byte-identity of
@@ -2746,6 +2924,25 @@ def _phase_tenants():
     return o
 
 
+def _phase_integrity():
+    o = integrity_bench()
+    print(f"# integrity (R=2, verify on, corrupt:1.0 at the serving "
+          f"rank): {o.get('integrity_injected', 0)} corruptions "
+          f"injected -> {o.get('integrity_detected', 0)} detected, "
+          f"{o.get('integrity_failovers', 0)} replica-served repairs, "
+          f"{o.get('integrity_giveups', 0)} give-ups, "
+          f"{o.get('integrity_corrupt_errors', 0)} kErrCorrupt, "
+          f"oracle byte-identical; scrub found "
+          f"{o.get('integrity_scrub_divergent', 0)} divergent "
+          f"mirror(s), repaired "
+          f"{o.get('integrity_scrub_repaired', 0)} "
+          f"(clean after: {o.get('integrity_scrub_clean_after', -1)}); "
+          f"verify-on overhead {o.get('integrity_overhead_x', 0):.2f}x "
+          f"-> {'OK' if o.get('integrity_ok') else 'NOT OK'}",
+          file=sys.stderr)
+    return o
+
+
 def _phase_trace():
     o = trace_bench()
     print(f"# trace A/B (off/on over the 4-owner scatter workload): "
@@ -2827,7 +3024,8 @@ _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("lmlong", _phase_lmlong), ("attnlong", _phase_attnlong),
            ("ppsched", _phase_ppsched), ("chaos", _phase_chaos),
            ("failover", _phase_failover), ("tenants", _phase_tenants),
-           ("trace", _phase_trace), ("soak", _phase_soak))
+           ("trace", _phase_trace), ("integrity", _phase_integrity),
+           ("soak", _phase_soak))
 
 
 def _kill_group(proc):
@@ -2924,6 +3122,10 @@ def main():
     # path; same own-cap pattern as the other host-only diagnostics.
     trace_timeout = float(os.environ.get(
         "DDSTORE_TRACE_PHASE_TIMEOUT_S", 300))
+    # The integrity phase runs corruption injection + scrub repair +
+    # an off/on overhead A/B over the wire path; same own-cap pattern.
+    integrity_timeout = float(os.environ.get(
+        "DDSTORE_INTEGRITY_PHASE_TIMEOUT_S", 300))
     # The lanes A/B runs three full store lifetimes (1-lane, N-lane,
     # autotuned) over the wire path; its own cap (soak/ppsched/chaos
     # pattern) keeps a slow run from eating a device phase's budget.
@@ -2957,7 +3159,8 @@ def main():
     device_phases = {n for n, _ in _PHASES
                      if n not in ("local", "tcp", "readahead", "lanes",
                                   "sched", "chaos", "failover",
-                                  "tenants", "trace", "soak")}
+                                  "tenants", "trace", "integrity",
+                                  "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -3066,6 +3269,7 @@ def main():
                              "failover": failover_timeout,
                              "tenants": tenants_timeout,
                              "trace": trace_timeout,
+                             "integrity": integrity_timeout,
                              "lanes": lanes_timeout,
                              "sched": sched_timeout}.get(name, timeout)
             try:

@@ -220,7 +220,22 @@ def check_functions(model: Model) -> Tuple[List[Finding],
                                            List[Tuple[str, str, str]]]:
     """Run the per-function detectors. Returns (findings,
     observed_edges) where an edge is (held_mutex, acquired_mutex,
-    site)."""
+    site).
+
+    Besides the purely lexical edges (locks nested inside one function
+    body), a ONE-LEVEL call-graph propagation pass runs afterwards: a
+    helper that takes a lock propagates that acquisition edge to its
+    direct callers — ``g() { lock(A); Helper(); }`` with
+    ``Helper() { lock(B); }`` records the A→B edge at g's call site,
+    which purely lexical analysis misses entirely. Acquisitions a
+    helper makes under its ``DDS_REQUIRES`` context are covered the
+    same way (the required mutexes are modeled as held inside the
+    helper, so its base-frame edges exist; the propagation adds the
+    CALLER-held edges on top). Resolution is deliberately
+    conservative — a typed receiver or a same-class bare call only, no
+    virtual dispatch guessing — so a propagated edge is as trustworthy
+    as a lexical one. One level, not transitive closure: summaries
+    hold each function's OWN acquisitions only."""
     findings: List[Finding] = []
     edges: List[Tuple[str, str, str]] = []
     seen: Set[str] = set()
@@ -232,8 +247,24 @@ def check_functions(model: Model) -> Tuple[List[Finding],
             seen.add(f.key())
             findings.append(f)
 
+    # (cls-or-None, name) -> union of mutexes the function(s) acquire
+    # in their own (non-lambda) frames; overloads merge conservatively.
+    summaries: Dict[Tuple[Optional[str], str], Set[str]] = {}
+    # call sites with locks held: (caller, callee_cls, callee, held, line)
+    calls: List[Tuple[FunctionInfo, Optional[str], str,
+                      "frozenset[str]", int]] = []
     for fn in model.functions:
-        _check_one(model, fn, emit, edges)
+        acquired = _check_one(model, fn, emit, edges, calls)
+        summaries.setdefault((fn.cls, fn.name), set()).update(acquired)
+    for caller, cls, callee, held, line in calls:
+        acq = summaries.get((cls, callee))
+        if not acq:
+            continue
+        for a in held:
+            for b in acq:
+                edges.append((a, b,
+                              f"{caller.file}:{line} ({caller.qual} -> "
+                              f"{callee}, one-level propagation)"))
     return findings, edges
 
 
@@ -269,12 +300,18 @@ def _guard_of(model: Model, cls_short: str, field: str,
     return model.resolve_mutex(ci.guarded[field], ctx or cls_short)
 
 
-def _check_one(model: Model, fn: FunctionInfo, emit, edges) -> None:
+def _check_one(model: Model, fn: FunctionInfo, emit, edges,
+               calls=None) -> Set[str]:
     var_types = _var_types(model, fn)
     required = _requires_of(model, fn)
     excluded = set(_excludes_of(model, fn))
     base = [_Acq(m, 0, None) for m in required]
     frames: List[_Frame] = [_Frame(base)]
+    # Mutexes this function acquires in its OWN (non-lambda) frames —
+    # the one-level call-graph summary check_functions propagates to
+    # call sites. DDS_REQUIRES mutexes are excluded: the caller holds
+    # those already, they are not acquisitions of this function.
+    acquired_summary: Set[str] = set()
     toks = fn.body
     texts = [t.text for t in toks]
     n = len(toks)
@@ -302,6 +339,8 @@ def _check_one(model: Model, fn: FunctionInfo, emit, edges) -> None:
                  f"{fn.qual}@{mid}",
                  f"{fn.qual} is DDS_EXCLUDES({_short(mid)}) but "
                  f"acquires it")
+        if len(frames) == 1:  # not inside a deferred-execution lambda
+            acquired_summary.add(mid)
         fr.acqs.append(_Acq(mid, fr.depth, var))
 
     i = 0
@@ -434,6 +473,16 @@ def _check_one(model: Model, fn: FunctionInfo, emit, edges) -> None:
                     req_cls = var_types[basev]
             else:
                 req_cls = fn.cls
+            # One-level call-graph propagation: record the call site
+            # with the locks held RIGHT NOW; check_functions joins it
+            # against the callee's acquisition summary afterwards.
+            # Same conservative resolution as the requires check (a
+            # typed receiver or a same-class bare call).
+            if calls is not None and req_cls:
+                hid = held_ids()
+                if hid:
+                    calls.append((fn, req_cls, x, frozenset(hid),
+                                  t.line))
             if req_cls:
                 for c in model._context_chain(req_cls):
                     for expr in c.requires.get(x, []):
@@ -481,6 +530,7 @@ def _check_one(model: Model, fn: FunctionInfo, emit, edges) -> None:
                              f"(DDS_GUARDED_BY({_short(gid)})) without "
                              f"holding it")
         i += 1
+    return acquired_summary
 
 
 def _short(mutex_id: str) -> str:

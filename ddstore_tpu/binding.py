@@ -180,6 +180,18 @@ def _load() -> ctypes.CDLL:
                                         ctypes.c_char_p]
     lib.dds_fault_stats.restype = ctypes.c_int
     lib.dds_fault_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_integrity_configure.restype = ctypes.c_int
+    lib.dds_integrity_configure.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                            ctypes.c_long]
+    lib.dds_integrity_stats.restype = ctypes.c_int
+    lib.dds_integrity_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_integrity_sums.restype = ctypes.c_int
+    lib.dds_integrity_sums.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       _i64, _i64,
+                                       ctypes.POINTER(ctypes.c_uint64),
+                                       _i64p]
+    lib.dds_integrity_scrub.restype = ctypes.c_int
+    lib.dds_integrity_scrub.argtypes = [ctypes.c_void_p]
     lib.dds_trace_configure.restype = ctypes.c_int
     lib.dds_trace_configure.argtypes = [ctypes.c_int, ctypes.c_long]
     lib.dds_trace_enabled.restype = ctypes.c_int
@@ -223,6 +235,13 @@ ERR_PEER_LOST = -10  # transient-retry budget exhausted: owner presumed
 ERR_QUOTA = -11      # tenant byte/var budget exhausted at registration:
 #                      admission refused — nothing died, free variables
 #                      or raise the quota (distinct from ERR_PEER_LOST)
+ERR_CORRUPT = -12    # data integrity failure (DDSTORE_VERIFY=1): the
+#                      delivered bytes disagree with the owner's
+#                      published checksums at a stable content version
+#                      on every readable holder — non-fatal like
+#                      ERR_QUOTA (nothing died; the store's bytes may
+#                      be fine and only one holder rotten — inspect
+#                      integrity_stats()["last_corrupt_peer"])
 
 
 class DDStoreError(RuntimeError):
@@ -292,6 +311,7 @@ TRACE_TYPES = {
     12: "window_stall", 13: "plan_replan", 14: "plan_applied",
     15: "suspect", 16: "suspect_clear", 17: "quota_reject",
     18: "lane_budget_rotate", 19: "flight", 20: "failover",
+    21: "verify_fail", 22: "scrub",
 }
 #: name -> code view of :data:`TRACE_TYPES` (Python-side emitters).
 TRACE_TYPE_CODES = {v: k for k, v in TRACE_TYPES.items()}
@@ -302,7 +322,7 @@ TRACE_OP_CLASSES = {0: "get", 1: "get_batch", 2: "read_runs",
 
 #: flight-recorder trigger codes (trace.h FlightReason).
 TRACE_FLIGHT_REASONS = {1: "peer_lost", 2: "quota", 3: "window_giveup",
-                        4: "suspect", 5: "manual"}
+                        4: "suspect", 5: "manual", 6: "corrupt"}
 
 #: dict keys of :func:`trace_stats`, in native layout order (keep in
 #: sync with capi dds_trace_stats / trace::Stats).
@@ -450,7 +470,26 @@ FAULT_STAT_KEYS = (
     "injected_stall", "injected_delay_ms",
     "retry_transient", "retry_attempts", "retry_reconnects",
     "retry_backoff_ms", "retry_giveups", "retry_fatal", "last_error_peer",
+    "injected_corrupt",
 )
+
+
+#: dict keys of :meth:`NativeStore.integrity_stats`, in native layout
+#: order (keep in sync with capi dds_integrity_stats /
+#: Store::IntegrityStats). ``verify_mode``/``sums_tables``/
+#: ``last_corrupt_peer`` are GAUGES; everything else is monotone since
+#: store creation (PipelineMetrics diffs those per epoch into
+#: ``summary()["integrity"]``).
+INTEGRITY_STAT_KEYS = (
+    "verify_mode", "sums_tables", "sums_computed", "sums_rows",
+    "sums_served", "verified_reads", "verified_bytes",
+    "verify_mismatches", "verify_seq_retries", "verify_primary_retries",
+    "verify_failovers", "corrupt_errors", "scrub_rows",
+    "scrub_divergent", "scrub_repaired", "last_corrupt_peer",
+)
+
+#: the gauge subset of :data:`INTEGRITY_STAT_KEYS` (never delta'd).
+INTEGRITY_GAUGE_KEYS = ("verify_mode", "sums_tables", "last_corrupt_peer")
 
 
 def _as_i64p(arr: np.ndarray):
@@ -957,6 +996,56 @@ class NativeStore:
         from .utils.metrics import plan_stats_delta
 
         return plan_stats_delta({}, raw)
+
+    # -- end-to-end data integrity -----------------------------------------
+
+    def integrity_configure(self, verify: int = -1,
+                            scrub_ms: int = -1) -> None:
+        """Runtime integrity toggles (load-time: ``DDSTORE_VERIFY`` /
+        ``DDSTORE_SCRUB_MS``): ``verify`` -1 keeps / 0 off / 1 on
+        (reader-side checksum verification; also enables sum
+        computation); ``scrub_ms`` -1 keeps / 0 stops the background
+        scrubber / >0 (re)starts it at that per-mirror tick."""
+        _check(self._lib.dds_integrity_configure(
+            self._h, int(verify), int(scrub_ms)),
+            f"integrity_configure({verify}, {scrub_ms})")
+
+    def integrity_stats(self) -> dict:
+        """Integrity counters (:data:`INTEGRITY_STAT_KEYS`): sum-table
+        builds/serves, verified reads/bytes, mismatch/retry/failover
+        ladder activity, surfaced ``ERR_CORRUPT`` errors and the
+        scrubber's checked/divergent/repaired ledger. Monotone except
+        the :data:`INTEGRITY_GAUGE_KEYS` gauges."""
+        arr = (ctypes.c_int64 * 16)()
+        _check(self._lib.dds_integrity_stats(self._h, arr),
+               "integrity_stats")
+        return dict(zip(INTEGRITY_STAT_KEYS,
+                        list(arr)[:len(INTEGRITY_STAT_KEYS)]))
+
+    def integrity_sums(self, name: str, row0: int = 0,
+                       count: Optional[int] = None):
+        """The LOCAL shard's per-row checksum table slice ``[row0,
+        row0+count)`` as ``(sums, seq)`` — ``sums`` a uint64 array,
+        ``seq`` the content version it describes. Builds the table
+        lazily; raises while integrity is disabled. Test/debug hook."""
+        if count is None:
+            count = int(self.query(name)["local_rows"]) - row0
+        out = np.empty(max(int(count), 0), dtype=np.uint64)
+        seq = _i64(-1)
+        _check(self._lib.dds_integrity_sums(
+            self._h, name.encode(), int(row0), int(count),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.byref(seq)), f"integrity_sums({name})")
+        return out, int(seq.value)
+
+    def integrity_scrub(self) -> int:
+        """One synchronous scrub pass over every resident mirror;
+        returns the number of divergent mirrors found (repairs run
+        inline, counted in :meth:`integrity_stats`)."""
+        n = int(self._lib.dds_integrity_scrub(self._h))
+        if n < 0:
+            raise DDStoreError(n, "integrity_scrub")
+        return n
 
     def fault_stats(self) -> dict:
         """Fault-injection + transient-retry counters: the process-global

@@ -180,6 +180,13 @@ class DeviceLoader:
             self.metrics.set_trace_source(
                 store.trace_summary,
                 getattr(store, "trace_stats", None))
+        if store is not None and hasattr(store, "integrity_stats"):
+            # Integrity ledger: summary()["integrity"] carries this
+            # epoch's verified reads/bytes, mismatch/retry/failover
+            # ladder activity and scrub results whenever verification
+            # or scrubbing is in force (inert — and absent from the
+            # summary — while both are off).
+            self.metrics.set_integrity_source(store.integrity_stats)
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether

@@ -147,6 +147,49 @@ int dds_failover_stats(dds_handle* h, int64_t out[16]) {
   return dds::kOk;
 }
 
+// -- end-to-end data integrity ------------------------------------------------
+
+// Runtime integrity toggles: verify -1 keeps / 0 off / 1 on (reader-
+// side verification; also enables sum computation); scrub_ms -1 keeps /
+// 0 stops the background scrubber / >0 (re)starts it at that
+// per-mirror tick. Load-time equivalents: DDSTORE_VERIFY /
+// DDSTORE_SCRUB_MS.
+int dds_integrity_configure(dds_handle* h, int verify, long scrub_ms) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->ConfigureIntegrity(verify, scrub_ms);
+}
+
+// Integrity observability snapshot. Layout (keep in sync with
+// binding.py INTEGRITY_STAT_KEYS): [verify_mode, sums_tables,
+// sums_computed, sums_rows, sums_served, verified_reads,
+// verified_bytes, verify_mismatches, verify_seq_retries,
+// verify_primary_retries, verify_failovers, corrupt_errors,
+// scrub_rows, scrub_divergent, scrub_repaired, last_corrupt_peer].
+int dds_integrity_stats(dds_handle* h, int64_t out[16]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->IntegrityStats(out);
+  return dds::kOk;
+}
+
+// Owner-side sum read (test/debug hook): `count` per-row checksums of
+// the LOCAL shard of `name` starting at local row `row0`, plus the
+// content version they were computed at. Builds the table lazily;
+// kErrNotFound while integrity is disabled.
+int dds_integrity_sums(dds_handle* h, const char* name, int64_t row0,
+                       int64_t count, uint64_t* out, int64_t* seq) {
+  if (!h || !name || !out) return dds::kErrInvalidArg;
+  return h->store->RowSums(name, row0, count, out, seq);
+}
+
+// One synchronous scrub pass over every resident mirror (the
+// deterministic test/bench hook; the DDSTORE_SCRUB_MS thread does the
+// same one mirror per tick). Returns the number of divergent mirrors
+// found, or a negative ErrorCode.
+int dds_integrity_scrub(dds_handle* h) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->ScrubOnce();
+}
+
 // -- tenant namespaces / quotas / snapshot epochs -----------------------------
 
 // Byte/var budget for one tenant (< 0 = unlimited). Checked-and-
@@ -475,7 +518,8 @@ int dds_fault_configure(const char* spec, uint64_t seed,
 //           backoff_ms, giveups, fatal
 //   [12]    last_error_peer (most recent failed target; -1 = none —
 //           the TCP layer's wins when both are set)
-//   [13..15] reserved (0)
+//   [13]    injected_corrupt (payloads served with flipped bytes)
+//   [14..15] reserved (0)
 int dds_fault_stats(dds_handle* h, int64_t out[16]) {
   if (!h || !out) return dds::kErrInvalidArg;
   for (int i = 0; i < 16; ++i) out[i] = 0;
@@ -486,6 +530,7 @@ int dds_fault_stats(dds_handle* h, int64_t out[16]) {
   out[3] = fi.delay;
   out[4] = fi.stall;
   out[5] = fi.delay_ms;
+  out[13] = fi.corrupt;
   int64_t st[7], tc[7] = {0, 0, 0, 0, 0, 0, -1};
   h->store->RetryCounters(st);
   if (h->tcp) h->tcp->RetryCounters(tc);

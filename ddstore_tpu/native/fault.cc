@@ -36,6 +36,9 @@ bool ParseKind(const std::string& tok, FaultKind* kind, int* dflt_ms) {
   } else if (tok == "stall") {
     *kind = FaultKind::kStall;
     *dflt_ms = 2000;
+  } else if (tok == "corrupt") {
+    *kind = FaultKind::kCorrupt;
+    *dflt_ms = 8;  // bytes to flip per injected event
   } else {
     return false;
   }
@@ -121,6 +124,7 @@ int FaultInjector::Configure(const std::string& spec, uint64_t seed,
     c_delay_.store(0);
     c_stall_.store(0);
     c_delay_ms_.store(0);
+    c_corrupt_.store(0);
     enabled_.store(!rules_.empty(), std::memory_order_release);
   }
   return kOk;
@@ -157,10 +161,15 @@ FaultDecision FaultInjector::Draw(int rank) {
           c_stall_.fetch_add(1, std::memory_order_relaxed);
           c_delay_ms_.fetch_add(r.param_ms, std::memory_order_relaxed);
           break;
+        case FaultKind::kCorrupt:
+          c_corrupt_.fetch_add(1, std::memory_order_relaxed);
+          break;
         case FaultKind::kNone:
           break;
       }
-      return FaultDecision{r.kind, r.param_ms};
+      // A second Mix64 pass decorrelates the corruption positions from
+      // the rule-selection comparison (both pure functions of the draw).
+      return FaultDecision{r.kind, r.param_ms, Mix64(h)};
     }
   }
   return {};
@@ -174,6 +183,7 @@ FaultInjector::Stats FaultInjector::stats() const {
   s.delay = c_delay_.load();
   s.stall = c_stall_.load();
   s.delay_ms = c_delay_ms_.load();
+  s.corrupt = c_corrupt_.load();
   return s;
 }
 

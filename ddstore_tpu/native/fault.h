@@ -42,12 +42,37 @@ enum class FaultKind : int {
   kDelay,   // sleep param_ms before serving (latency, no error)
   kStall,   // sleep param_ms (default 2000) — long enough to trip the
             // client's DDSTORE_READ_TIMEOUT_S in chaos tests
+  kCorrupt, // serve the response with param (default 8) payload bytes
+            // bit-flipped at positions derived from the draw hash —
+            // the frame is well-formed and no transport error fires,
+            // so ONLY checksum verification (DDSTORE_VERIFY=1) can
+            // catch it. Spec arm: "corrupt:p[:nbytes]".
 };
 
 struct FaultDecision {
   FaultKind kind = FaultKind::kNone;
-  int param_ms = 0;
+  int param_ms = 0;   // delay/stall: sleep ms; corrupt: bytes to flip
+  uint64_t h = 0;     // the draw's hash — corrupt positions/masks are a
+                      // pure function of it, so seeded schedules
+                      // reproduce byte-identical corruption
 };
+
+// Flip `nbytes` bytes of `p[0..n)` deterministically from `h` (each
+// XORed with a nonzero mask, so every targeted byte really changes).
+// Shared by the TCP serve loop (payload staged through scratch — shard
+// memory itself is never touched) and the local transport (landed dst
+// bytes).
+inline void CorruptBytes(void* p, int64_t n, uint64_t h, int nbytes) {
+  if (n <= 0 || nbytes <= 0) return;
+  unsigned char* b = static_cast<unsigned char*>(p);
+  const int64_t pos = static_cast<int64_t>(h % static_cast<uint64_t>(n));
+  for (int i = 0; i < nbytes; ++i) {
+    unsigned char mask =
+        static_cast<unsigned char>((h >> ((i % 8) * 8)) & 0xFF);
+    if (!mask) mask = 0xA5;
+    b[(pos + i) % n] ^= mask;
+  }
+}
 
 class FaultInjector {
  public:
@@ -79,6 +104,7 @@ class FaultInjector {
     int64_t delay = 0;
     int64_t stall = 0;
     int64_t delay_ms = 0;  // total injected sleep (delay + stall)
+    int64_t corrupt = 0;   // payloads served with flipped bytes
   };
   Stats stats() const;
 
@@ -98,7 +124,7 @@ class FaultInjector {
   std::atomic<bool> enabled_{false};
   std::atomic<uint64_t> n_{0};  // draw counter
   std::atomic<int64_t> c_checks_{0}, c_reset_{0}, c_trunc_{0}, c_delay_{0},
-      c_stall_{0}, c_delay_ms_{0};
+      c_stall_{0}, c_delay_ms_{0}, c_corrupt_{0};
 };
 
 // -- transient-retry policy --------------------------------------------------

@@ -85,9 +85,12 @@ namespace {
 // retry layer, since this transport has no internal retry; stall fails
 // WITHOUT sleeping — there is no client timeout to trip on the local
 // path, and an uninterruptible 2 s sleep would only serialize the
-// consumer); delay serves late. One draw per transport call, same
+// consumer); delay serves late; corrupt is returned to the CALLER,
+// which performs the read and then flips the landed bytes — the local
+// analogue of a mangled wire payload (no error fires; only checksum
+// verification can notice). One draw per transport call, same
 // determinism contract as the TCP serve loop.
-int DrawLocalFault(int rank) {
+int DrawLocalFault(int rank, FaultDecision* corrupt) {
   FaultInjector& fi = FaultInjector::Get();
   if (!fi.enabled()) return kOk;
   const FaultDecision d = fi.Draw(rank);
@@ -98,6 +101,9 @@ int DrawLocalFault(int rank) {
       return kErrTransport;
     case FaultKind::kDelay:
       FaultSleepMs(d.param_ms, nullptr);
+      break;
+    case FaultKind::kCorrupt:
+      if (corrupt) *corrupt = d;
       break;
     case FaultKind::kNone:
       break;
@@ -113,15 +119,27 @@ int LocalTransport::Read(int target, const std::string& name, int64_t offset,
   // Drawn as the TARGET rank: the injected fault models the PEER's serve
   // path failing, matching the TCP side (and the DDSTORE_FAULT_RANKS
   // filter's "inject when these ranks serve" semantics).
-  if (int rc = DrawLocalFault(target)) return rc;
+  FaultDecision corrupt;
+  if (int rc = DrawLocalFault(target, &corrupt)) return rc;
   // ReadLocal holds the peer's read lock across the copy, so a concurrent
   // FreeVar on the peer cannot free the shard mid-read.
-  return peer->ReadLocal(name, offset, nbytes, dst);
+  const int rc = peer->ReadLocal(name, offset, nbytes, dst);
+  if (rc == kOk && corrupt.kind == FaultKind::kCorrupt)
+    CorruptBytes(dst, nbytes, corrupt.h | 1, corrupt.param_ms);
+  return rc;
 }
 
 int64_t LocalTransport::ReadVarSeq(int target, const std::string& name) {
   Store* peer = group_->member(target);
   return peer ? peer->UpdateSeqOf(name) : -1;
+}
+
+int LocalTransport::ReadRowSums(int target, const std::string& name,
+                                int64_t row0, int64_t count,
+                                int64_t* seq, uint64_t* sums) {
+  Store* peer = group_->member(target);
+  if (!peer) return kErrTransport;
+  return peer->RowSums(name, row0, count, sums, seq);
 }
 
 int LocalTransport::SnapshotControl(int target, int64_t snap_id,
@@ -138,8 +156,17 @@ int LocalTransport::ReadV(int target, const std::string& name,
   // (the base-class default would pay both per op).
   Store* peer = group_->member(target);
   if (!peer) return kErrTransport;
-  if (int rc = DrawLocalFault(target)) return rc;
-  return peer->ReadLocalV(name, ops, n);
+  FaultDecision corrupt;
+  if (int rc = DrawLocalFault(target, &corrupt)) return rc;
+  const int rc = peer->ReadLocalV(name, ops, n);
+  if (rc == kOk && corrupt.kind == FaultKind::kCorrupt && n > 0) {
+    // One op of the batch gets its landed bytes flipped (deterministic
+    // pick): the local-memcpy analogue of a corrupted wire frame.
+    const ReadOp& op = ops[corrupt.h % static_cast<uint64_t>(n)];
+    CorruptBytes(op.dst, op.nbytes, (corrupt.h >> 8) | 1,
+                 corrupt.param_ms);
+  }
+  return rc;
 }
 
 }  // namespace dds

@@ -84,6 +84,10 @@ class LocalTransport : public Transport {
   // Control-plane content-version probe (mirror refresh gate): direct
   // registry read of the peer store, no fault-injector draw.
   int64_t ReadVarSeq(int target, const std::string& name) override;
+  // Integrity sum fetch: direct call into the peer store's owner-side
+  // table (control plane, no fault-injector draw).
+  int ReadRowSums(int target, const std::string& name, int64_t row0,
+                  int64_t count, int64_t* seq, uint64_t* sums) override;
   // Snapshot-epoch pin/release: direct call into the peer store's
   // owner-side half (control plane, no fault-injector draw).
   int SnapshotControl(int target, int64_t snap_id, bool pin,

@@ -62,6 +62,9 @@ const char* ErrorString(int code) {
                               "exhausted; owner presumed dead)";
     case kErrQuota: return "tenant quota exceeded (admission refused; "
                            "free variables or raise the budget)";
+    case kErrCorrupt: return "data integrity failure (delivered bytes "
+                             "disagree with the owner's published "
+                             "checksums on every readable holder)";
     default: return "unknown error";
   }
 }
@@ -182,7 +185,24 @@ Store::Store(std::unique_ptr<Transport> transport)
         if (end != v.c_str() && !*end && w >= 1)
           SetTenantShare(t, static_cast<int>(w));
       });
+  // Integrity: sum computation engages when anything can consume the
+  // sums (reader verification or the scrubber); the default tree
+  // computes nothing, fetches nothing, draws nothing.
+  sum_seed_ = integrity::SeedFromEnv();
+  if (const char* env = std::getenv("DDSTORE_VERIFY"))
+    verify_.store(std::strtol(env, nullptr, 10) != 0,
+                  std::memory_order_relaxed);
+  long scrub_ms = 0;
+  if (const char* env = std::getenv("DDSTORE_SCRUB_MS")) {
+    char* end = nullptr;
+    const long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) scrub_ms = v;
+  }
+  integrity_on_.store(
+      verify_.load(std::memory_order_relaxed) || scrub_ms > 0,
+      std::memory_order_relaxed);
   health_.Init(rank(), world());
+  if (scrub_ms > 0) ConfigureScrub(scrub_ms);
   if (world() > 1) {
     // Transports with an internal retry layer (TCP leaves) consult the
     // suspect view between attempts (snapshotted once per leaf; the
@@ -200,8 +220,10 @@ Store::Store(std::unique_ptr<Transport> transport)
 }
 
 Store::~Store() {
-  // The ping thread dials through the transport; stop it before any
-  // teardown the transport participates in.
+  // The scrubber reads shards and the control plane; the ping thread
+  // dials through the transport: both must stop before any teardown
+  // the transport participates in.
+  StopScrub();
   health_.Stop();
   // In-flight async reads hold the shared lock and use the transport;
   // both must still exist while they finish.
@@ -317,6 +339,11 @@ int Store::AddInternal(const std::string& name, const void* buf, int64_t nrows,
   }
   const VarInfo& placed = vars_.emplace(name, std::move(v)).first->second;
   transport_->PublishVar(name, placed.base, placed.shard_bytes());
+  lock.unlock();
+  // Eager sum build at registration (EnsureOwnSums takes the shared
+  // lock itself): the owner's table exists before any holder can pull
+  // a mirror or verify a read against it.
+  if (integrity_on_.load(std::memory_order_relaxed)) EnsureOwnSums(name);
   return kOk;
 }
 
@@ -354,6 +381,31 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
   std::memcpy(v.base + row_offset * v.row_bytes(), buf,
               nrows * v.row_bytes());
   ++v.update_seq;  // mirror holders re-pull at their next epoch fence
+  if (integrity_on_.load(std::memory_order_relaxed)) {
+    // Refresh the sum table IN the exclusive section, so data at seq S
+    // and sums at seq S publish atomically with respect to readers
+    // (the verify ladder's seq-race retry handles cross-epoch skew;
+    // a table that lagged its data by one Update inside the lock
+    // would make every post-update verified read a false mismatch).
+    std::lock_guard<std::mutex> sl(sums_mu_);
+    auto t = sum_tables_.find(name);
+    if (t != sum_tables_.end()) {
+      integrity::SumTable& st = t->second;
+      if (st.seq == v.update_seq - 1 &&
+          static_cast<int64_t>(st.sums.size()) == v.nrows) {
+        const int64_t rb = v.row_bytes();
+        for (int64_t r = row_offset; r < row_offset + nrows; ++r)
+          st.sums[static_cast<size_t>(r)] =
+              integrity::RowSum(v.base + r * rb, rb, r, sum_seed_);
+        st.seq = v.update_seq;
+        icnt_.sums_computed.fetch_add(1, std::memory_order_relaxed);
+        icnt_.sums_rows.fetch_add(nrows, std::memory_order_relaxed);
+      } else {
+        // Stale/foreign table: drop it — the next serve rebuilds lazily.
+        sum_tables_.erase(t);
+      }
+    }
+  }
   transport_->PublishVar(name, v.base, v.shard_bytes());
   return kOk;
 }
@@ -377,15 +429,24 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
   // Span root of this read: every transport/retry/failover event below
   // (including the serving rank's, via the frame tag) records under it.
   trace::ScopedOp top(rank(), trace::kClsGet, target, nbytes);
-  int rc;
-  if (target == rank()) {
-    rc = ReadLocal(name, offset, nbytes, dst);
-  } else if (replication_ <= 1) {
-    rc = RetryTransient(
+  // The retried primary read, shared by both replication branches and
+  // (as the `reread` hook) by the verify ladder.
+  auto primary_read = [&]() {
+    return RetryTransient(
         [&]() {
           return transport_->Read(target, name, offset, nbytes, dst);
         },
         target);
+  };
+  int rc;
+  if (target == rank()) {
+    rc = ReadLocal(name, offset, nbytes, dst);
+  } else if (replication_ <= 1) {
+    rc = primary_read();
+    if (rc == kOk && verify_.load(std::memory_order_relaxed)) {
+      const ReadOp op{offset, nbytes, dst};
+      rc = VerifyAfterRead(name, target, &op, 1, primary_read);
+    }
   } else {
     // Replicated single-peer read: same failover contract as the
     // batched paths (suspect short-circuit, ladder verdict -> replica
@@ -395,11 +456,7 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
     rc = kErrPeerLost;
     bool via_replica = true;
     if (!PeerSuspected(target)) {
-      rc = RetryTransient(
-          [&]() {
-            return transport_->Read(target, name, offset, nbytes, dst);
-          },
-          target);
+      rc = primary_read();
       via_replica = rc == kErrPeerLost;
       if (via_replica) MarkPeerSuspected(target);
     } else {
@@ -408,6 +465,9 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
     if (via_replica) {
       std::vector<ReadOp> ops(1, ReadOp{offset, nbytes, dst});
       rc = ReadViaReplica(name, target, ops);
+    } else if (rc == kOk && verify_.load(std::memory_order_relaxed)) {
+      const ReadOp op{offset, nbytes, dst};
+      rc = VerifyAfterRead(name, target, &op, 1, primary_read);
     }
   }
   if (rc == kOk) AccountTenantRead(name, nbytes, as_tenant);
@@ -708,14 +768,55 @@ int Store::FillMirror(const std::string& name, int owner,
       rb >= kFillChunk ? rb : kFillChunk - (kFillChunk % rb);
   std::unique_ptr<char[]> scratch(
       new char[static_cast<size_t>(bytes < chunk ? bytes : chunk)]);
+  // Verified fills (DDSTORE_VERIFY=1): each row-aligned chunk is
+  // checksummed against the owner's published table BEFORE it is
+  // installed — a mirror fill (including a scrub repair) must never
+  // propagate corrupt wire bytes into the replica chain. Only engaged
+  // when the owner's table exists at exactly the seq this pull is for;
+  // any other state (unknown seq, integrity off on the owner) fills
+  // unverified, the pre-integrity behavior.
+  std::shared_ptr<const integrity::SumTable> vtab;
+  bool verify_fill = false;
+  if (verify_.load(std::memory_order_relaxed) && src_seq >= 0 &&
+      (name.empty() || name[0] != '\x03')) {
+    // A cached table at another seq is refetched, not a reason to
+    // disengage: every refill after the owner's first Update would
+    // otherwise install wire bytes unverified.
+    verify_fill = EnsureSumTable(owner, name, nrows, &vtab, false) &&
+                  vtab->seq == src_seq;
+    if (!verify_fill)
+      verify_fill = EnsureSumTable(owner, name, nrows, &vtab, true) &&
+                    vtab->seq == src_seq;
+  }
   for (int64_t off = 0; off < bytes; off += chunk) {
     const int64_t take = bytes - off < chunk ? bytes - off : chunk;
-    int rc = RetryTransient(
-        [&]() {
-          return transport_->Read(owner, name, off, take, scratch.get());
-        },
-        owner);
+    auto pull = [&]() {
+      return RetryTransient(
+          [&]() {
+            return transport_->Read(owner, name, off, take, scratch.get());
+          },
+          owner);
+    };
+    int rc = pull();
     if (rc != kOk) return rc;
+    if (verify_fill) {
+      auto chunk_ok = [&]() {
+        const int64_t row0 = off / rb, vrows = take / rb;
+        for (int64_t r = 0; r < vrows; ++r)
+          if (integrity::RowSum(scratch.get() + r * rb, rb, row0 + r,
+                                sum_seed_) !=
+              vtab->sums[static_cast<size_t>(row0 + r)])
+            return false;
+        return true;
+      };
+      if (!chunk_ok()) {
+        icnt_.mismatches.fetch_add(1, std::memory_order_relaxed);
+        trace::Ev(trace::kVerifyFail, rank(), owner, off / rb, -1);
+        rc = pull();  // one re-read, then refuse to install bad bytes
+        if (rc != kOk) return rc;
+        if (!chunk_ok()) return kErrCorrupt;
+      }
+    }
     std::unique_lock<std::shared_mutex> lock(mu_);
     auto it = vars_.find(mname);
     if (it == vars_.end()) return kErrNotFound;  // freed mid-fill
@@ -817,7 +918,20 @@ bool Store::PeerSuspected(int target) const {
 
 void Store::MarkPeerSuspected(int target) { health_.MarkSuspected(target); }
 
-void Store::ClearPeerSuspected(int target) { health_.ResetPeer(target); }
+void Store::ClearPeerSuspected(int target) {
+  health_.ResetPeer(target);
+  // A cleared peer is often a REPLACED peer (elastic recovery): the
+  // replacement may serve a different shard generation at the same
+  // content version (checkpoint rollback), so cached sum tables for it
+  // are no longer trustworthy — verified reads refetch on demand.
+  std::lock_guard<std::mutex> lock(sums_mu_);
+  for (auto it = sum_cache_.begin(); it != sum_cache_.end();) {
+    if (it->first.first == target)
+      it = sum_cache_.erase(it);
+    else
+      ++it;
+  }
+}
 
 int Store::HealthState(int64_t* out, int cap) const {
   return health_.SuspectFlags(out, cap);
@@ -852,6 +966,456 @@ void Store::FailoverCounters(int64_t out[16]) const {
   out[11] = hb[2];
   out[12] = hb[3];
   out[13] = health_.SuspectedCount();
+}
+
+// -- end-to-end data integrity ------------------------------------------------
+
+namespace {
+// "\x01mirror\x01<owner>\x01<base>" -> (owner, base).
+bool ParseMirrorName(const std::string& mname, int* owner,
+                     std::string* base) {
+  if (mname.compare(0, 8, "\x01mirror\x01") != 0) return false;
+  const size_t end = mname.find('\x01', 8);
+  if (end == std::string::npos) return false;
+  char* e = nullptr;
+  const long o = std::strtol(mname.c_str() + 8, &e, 10);
+  if (!e || *e != '\x01') return false;
+  *owner = static_cast<int>(o);
+  *base = mname.substr(end + 1);
+  return true;
+}
+}  // namespace
+
+int Store::ConfigureIntegrity(int verify, long scrub_ms) {
+  if (verify >= 0) {
+    verify_.store(verify != 0, std::memory_order_relaxed);
+    if (verify) integrity_on_.store(true, std::memory_order_relaxed);
+  }
+  if (scrub_ms >= 0) {
+    if (scrub_ms > 0) integrity_on_.store(true, std::memory_order_relaxed);
+    ConfigureScrub(scrub_ms);
+  }
+  return kOk;
+}
+
+int Store::EnsureOwnSums(const std::string& name) {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  const VarInfo& v = it->second;
+  {
+    std::lock_guard<std::mutex> sl(sums_mu_);
+    auto t = sum_tables_.find(name);
+    if (t != sum_tables_.end() && t->second.seq == v.update_seq &&
+        static_cast<int64_t>(t->second.sums.size()) == v.nrows)
+      return kOk;  // fresh
+  }
+  // Build under the SHARED registry lock (a concurrent Update holds
+  // the exclusive lock, so the bytes hashed here are a consistent
+  // version); publish under the leaf sums mutex. Two racing builders
+  // compute the same table — harmless.
+  integrity::SumTable st;
+  st.seq = v.update_seq;
+  st.sums.resize(static_cast<size_t>(v.nrows));
+  const int64_t rb = v.row_bytes();
+  for (int64_t r = 0; r < v.nrows; ++r)
+    st.sums[static_cast<size_t>(r)] =
+        integrity::RowSum(v.base + r * rb, rb, r, sum_seed_);
+  {
+    std::lock_guard<std::mutex> sl(sums_mu_);
+    sum_tables_[name] = std::move(st);
+  }
+  icnt_.sums_computed.fetch_add(1, std::memory_order_relaxed);
+  icnt_.sums_rows.fetch_add(v.nrows, std::memory_order_relaxed);
+  return kOk;
+}
+
+int Store::RowSums(const std::string& name, int64_t row0, int64_t count,
+                   uint64_t* out, int64_t* seq_out) {
+  if (!out || row0 < 0 || count < 0) return kErrInvalidArg;
+  if (!integrity_on_.load(std::memory_order_relaxed))
+    return kErrNotFound;  // readers treat this as "unverifiable"
+  const int rc = EnsureOwnSums(name);
+  if (rc != kOk) return rc;
+  std::lock_guard<std::mutex> lock(sums_mu_);
+  auto it = sum_tables_.find(name);
+  if (it == sum_tables_.end()) return kErrNotFound;
+  const integrity::SumTable& t = it->second;
+  const int64_t n = static_cast<int64_t>(t.sums.size());
+  if (row0 > n || count > n - row0) return kErrOutOfRange;
+  std::memcpy(out, t.sums.data() + row0,
+              static_cast<size_t>(count) * sizeof(uint64_t));
+  if (seq_out) *seq_out = t.seq;
+  icnt_.sums_served.fetch_add(1, std::memory_order_relaxed);
+  return kOk;
+}
+
+int64_t Store::CachedSumSeq(int owner, const std::string& name) const {
+  std::lock_guard<std::mutex> lock(sums_mu_);
+  auto it = sum_cache_.find(std::make_pair(owner, name));
+  return it == sum_cache_.end() ? -1 : it->second->seq;
+}
+
+void Store::InvalidateSumCache(int owner, const std::string& name) {
+  std::lock_guard<std::mutex> lock(sums_mu_);
+  sum_cache_.erase(std::make_pair(owner, name));
+}
+
+void Store::DropSumsFor(const std::string& name) {
+  std::lock_guard<std::mutex> lock(sums_mu_);
+  sum_tables_.erase(name);
+  for (auto it = sum_cache_.begin(); it != sum_cache_.end();) {
+    if (it->first.second == name)
+      it = sum_cache_.erase(it);
+    else
+      ++it;
+  }
+}
+
+bool Store::EnsureSumTable(int owner, const std::string& name,
+                           int64_t rows,
+                           std::shared_ptr<const integrity::SumTable>* out,
+                           bool refresh) {
+  if (rows < 0) return false;
+  const auto key = std::make_pair(owner, name);
+  if (!refresh) {
+    std::lock_guard<std::mutex> lock(sums_mu_);
+    auto it = sum_cache_.find(key);
+    if (it != sum_cache_.end()) {
+      *out = it->second;
+      return true;
+    }
+  }
+  auto t = std::make_shared<integrity::SumTable>();
+  if (owner == rank()) {
+    if (EnsureOwnSums(name) != kOk) return false;
+    std::lock_guard<std::mutex> lock(sums_mu_);
+    auto o = sum_tables_.find(name);
+    if (o == sum_tables_.end()) return false;
+    *t = o->second;
+  } else {
+    // Control-plane fetch, no lock held. Chunked; a seq change
+    // mid-fetch means the owner Update()d underneath — restart once
+    // (the verify ladder's seq-retry absorbs the rest).
+    t->sums.resize(static_cast<size_t>(rows));
+    constexpr int64_t kSumChunk = 65536;
+    for (int attempt = 0;; ++attempt) {
+      bool restart = false;
+      t->seq = -1;
+      for (int64_t got = 0; got < rows;) {
+        const int64_t take =
+            rows - got < kSumChunk ? rows - got : kSumChunk;
+        int64_t seq = -1;
+        if (transport_->ReadRowSums(owner, name, got, take, &seq,
+                                    t->sums.data() + got) != kOk)
+          return false;
+        if (t->seq == -1) {
+          t->seq = seq;
+        } else if (seq != t->seq) {
+          restart = true;
+          break;
+        }
+        got += take;
+      }
+      if (!restart) break;
+      if (attempt >= 1) return false;
+    }
+  }
+  std::lock_guard<std::mutex> lock(sums_mu_);
+  sum_cache_[key] = t;
+  *out = t;
+  return true;
+}
+
+int Store::VerifyOps(const std::string& name, int owner,
+                     const ReadOp* ops, int64_t n, int64_t* bad_row) {
+  if (!name.empty() && name[0] == '\x03')
+    return kErrNotFound;  // snapshot/kept views pin OLDER versions: the
+                          // current-seq sums cannot judge them
+  if (owner < 0 || owner >= world()) return kErrNotFound;
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  const int64_t rb = v.row_bytes();
+  if (rb <= 0 || static_cast<int>(v.cum.size()) <= owner)
+    return kErrNotFound;
+  const int64_t shard_rows =
+      v.cum[owner] - (owner == 0 ? 0 : v.cum[owner - 1]);
+  std::shared_ptr<const integrity::SumTable> tab;
+  if (!EnsureSumTable(owner, name, shard_rows, &tab, false))
+    return kErrNotFound;
+  icnt_.verified_reads.fetch_add(1, std::memory_order_relaxed);
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const ReadOp& op = ops[i];
+    if (op.nbytes <= 0) continue;
+    // Every read the store issues is row-aligned; anything else (a
+    // hand-crafted byte-offset op) is unverifiable and passes through.
+    if (op.offset % rb || op.nbytes % rb) continue;
+    const int64_t row0 = op.offset / rb;
+    const int64_t rows = op.nbytes / rb;
+    if (row0 + rows > static_cast<int64_t>(tab->sums.size())) continue;
+    const char* p = static_cast<const char*>(op.dst);
+    for (int64_t r = 0; r < rows; ++r) {
+      if (integrity::RowSum(p + r * rb, rb, row0 + r, sum_seed_) !=
+          tab->sums[static_cast<size_t>(row0 + r)]) {
+        if (bad_row) *bad_row = row0 + r;
+        return kErrCorrupt;
+      }
+    }
+    total += op.nbytes;
+  }
+  icnt_.verified_bytes.fetch_add(total, std::memory_order_relaxed);
+  return kOk;
+}
+
+int Store::VerifyAfterRead(const std::string& name, int owner,
+                           const ReadOp* ops, int64_t n,
+                           const std::function<int()>& reread) {
+  // An owner that DIES mid-ladder (a reread's budget exhausts) keeps
+  // the replicated read's failover contract: mark it suspected and
+  // serve from the replica chain — dead-owner semantics, bytes
+  // unverified by design (mirrors hold the last good pre-fence copy).
+  // Returning the bare kErrPeerLost here would strand a read the
+  // unverified tree, with a healthy mirror holder, would have served.
+  auto reread_failed = [&](int rc) -> int {
+    if (rc != kErrPeerLost || replication_ <= 1) return rc;
+    MarkPeerSuspected(owner);
+    std::vector<ReadOp> v(ops, ops + n);
+    return ReadViaReplica(name, owner, v);
+  };
+  int64_t bad = -1;
+  int vc = VerifyOps(name, owner, ops, n, &bad);
+  if (vc != kErrCorrupt) return kOk;  // verified or unverifiable
+  icnt_.mismatches.fetch_add(1, std::memory_order_relaxed);
+  trace::Ev(trace::kVerifyFail, rank(), owner, bad, -1);
+  // Rung 1+2 — bracketed re-verification, the seqlock protocol: each
+  // round observes the owner's content version, RE-READS the data,
+  // refetches the table, then observes the version again. A mismatch
+  // is only GENUINE when the whole round sat inside one stable version
+  // (seq1 == table.seq == seq2) — anything else is a concurrent
+  // Update racing the read, a clean transient. The stable round's
+  // re-read doubles as the one primary retry the ladder owes a
+  // transient wire flip.
+  bool stable = false;
+  bool control_ok = true;
+  for (int round = 0; round < 4 && !stable && reread; ++round) {
+    const int64_t seq1 = transport_->ReadVarSeq(owner, name);
+    if (seq1 < 0) {
+      // Owner's control plane unreachable: cannot bracket — fall
+      // through to the replica rung on the original verdict.
+      control_ok = false;
+      break;
+    }
+    const int rc = reread();
+    if (rc != kOk) return reread_failed(rc);
+    InvalidateSumCache(owner, name);
+    bad = -1;
+    vc = VerifyOps(name, owner, ops, n, &bad);  // refetches the table
+    if (vc != kErrCorrupt) return kOk;
+    icnt_.mismatches.fetch_add(1, std::memory_order_relaxed);
+    trace::Ev(trace::kVerifyFail, rank(), owner, bad, -1);
+    const int64_t seq2 = transport_->ReadVarSeq(owner, name);
+    stable = seq2 == seq1 && CachedSumSeq(owner, name) == seq1;
+    if (!stable)
+      icnt_.seq_retries.fetch_add(1, std::memory_order_relaxed);
+  }
+  if (vc != kErrCorrupt) return kOk;
+  if (!stable && control_ok) {
+    // The writer outran every bracket attempt: the delivered bytes ARE
+    // a consistent version (the owner's exclusive-locked Update makes
+    // each read atomic), just not one the control plane could certify
+    // mid-churn. Deliver; verification re-engages the moment the
+    // writer pauses. Counted above in verify_seq_retries.
+    return kOk;
+  }
+  if (stable)
+    icnt_.primary_retries.fetch_add(1, std::memory_order_relaxed);
+  // Rung 3 — the replica chain, every holder's bytes verified.
+  if (replication_ > 1) {
+    std::vector<ReadOp> v(ops, ops + n);
+    const int rc = ReadViaReplica(name, owner, v, /*verify_bytes=*/true);
+    if (rc == kOk) {
+      icnt_.verify_failovers.fetch_add(1, std::memory_order_relaxed);
+      return kOk;
+    }
+    if (rc != kErrCorrupt && rc != kErrPeerLost) return rc;
+    // kErrPeerLost here = no holder readable: the primary's disagreeing
+    // bytes remain the only testimony — classified corrupt below.
+  }
+  icnt_.corrupt_errors.fetch_add(1, std::memory_order_relaxed);
+  icnt_.last_corrupt_peer.store(owner, std::memory_order_relaxed);
+  return kErrCorrupt;
+}
+
+int Store::ScrubOnce() {
+  std::vector<std::string> mirrors;
+  {
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    for (const auto& kv : vars_)
+      if (!kv.first.empty() && kv.first[0] == '\x01')
+        mirrors.push_back(kv.first);
+  }
+  int divergent = 0;
+  for (const std::string& m : mirrors) {
+    std::string base;
+    int owner = -1;
+    if (!ParseMirrorName(m, &owner, &base)) continue;
+    const int rc = ScrubMirror(m, base, owner);
+    if (rc > 0) divergent += rc;
+  }
+  return divergent;
+}
+
+int Store::ScrubMirror(const std::string& mname, const std::string& base,
+                       int owner) {
+  if (owner < 0 || owner >= world() || owner == rank()) return 0;
+  // A suspected owner's mirror IS the failover data right now — and
+  // its sums are unreachable anyway.
+  if (PeerSuspected(owner)) return 0;
+  VarInfo mv;
+  int64_t src_seq = -1;
+  {
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    auto it = vars_.find(mname);
+    if (it == vars_.end()) return 0;
+    mv = it->second;
+    src_seq = it->second.mirror_src_seq;
+  }
+  const int64_t rb = mv.row_bytes();
+  if (rb <= 0 || mv.nrows == 0 || src_seq < 0) return 0;
+  // Version gates: an owner that Update()d since the pull makes the
+  // mirror legitimately STALE, not corrupt — the next epoch fence
+  // re-pulls it. The same gate protects snapshot KEPT copies by
+  // construction: scrub walks \x01 mirrors only, so a deliberately
+  // older kept version (\x03k) is never "repaired".
+  const int64_t cur = transport_->ReadVarSeq(owner, base);
+  if (cur < 0 || cur != src_seq) return 0;
+  std::shared_ptr<const integrity::SumTable> tab;
+  if (!EnsureSumTable(owner, base, mv.nrows, &tab, false)) return 0;
+  if (tab->seq != src_seq) {
+    if (!EnsureSumTable(owner, base, mv.nrows, &tab, true)) return 0;
+    if (tab->seq != src_seq) return 0;
+  }
+  // Hash the mirror in bounded row-aligned chunks through the locked
+  // read path (FillMirror's refresh copies whole rows under the
+  // exclusive lock, so every row hashes either old or new).
+  constexpr int64_t kScrubChunk = 4 << 20;
+  const int64_t chunk_rows = rb >= kScrubChunk ? 1 : kScrubChunk / rb;
+  std::unique_ptr<char[]> scratch(
+      new char[static_cast<size_t>(chunk_rows * rb)]);
+  int64_t divergent_rows = 0;
+  for (int64_t r0 = 0; r0 < mv.nrows; r0 += chunk_rows) {
+    const int64_t take =
+        mv.nrows - r0 < chunk_rows ? mv.nrows - r0 : chunk_rows;
+    ReadOp op{r0 * rb, take * rb, scratch.get()};
+    if (ReadLocalV(mname, &op, 1) != kOk) return 0;  // freed mid-scrub
+    for (int64_t r = 0; r < take; ++r)
+      if (integrity::RowSum(scratch.get() + r * rb, rb, r0 + r,
+                            sum_seed_) !=
+          tab->sums[static_cast<size_t>(r0 + r)])
+        ++divergent_rows;
+  }
+  icnt_.scrub_rows.fetch_add(mv.nrows, std::memory_order_relaxed);
+  if (divergent_rows == 0) {
+    trace::Ev(trace::kScrub, rank(), mv.nrows, 0, 0);
+    return 0;
+  }
+  icnt_.scrub_divergent.fetch_add(1, std::memory_order_relaxed);
+  // Repair: re-pull the whole mirror with the row-aligned FillMirror
+  // chunking (itself verified while verify mode is on).
+  VarInfo pv;
+  int repaired = 0;
+  if (GetVarInfo(base, &pv) &&
+      FillMirror(base, owner, pv, tab->seq) == kOk) {
+    icnt_.scrub_repaired.fetch_add(1, std::memory_order_relaxed);
+    repaired = 1;
+  }
+  trace::Ev(trace::kScrub, rank(), mv.nrows, divergent_rows, repaired);
+  return 1;
+}
+
+void Store::ConfigureScrub(long interval_ms) {
+  // The whole stop+start transition is one critical section: two
+  // concurrent configures racing between the join and the assignment
+  // would assign over a joinable std::thread (std::terminate).
+  std::lock_guard<std::mutex> cfg(scrub_cfg_mu_);
+  StopScrubLocked();
+  if (interval_ms <= 0 || world() <= 1) return;
+  std::lock_guard<std::mutex> lock(scrub_mu_);
+  scrub_stop_.store(false, std::memory_order_relaxed);
+  scrub_interval_ms_.store(interval_ms, std::memory_order_relaxed);
+  scrub_thread_ = std::thread([this] { ScrubLoop(); });
+}
+
+void Store::StopScrub() {
+  std::lock_guard<std::mutex> cfg(scrub_cfg_mu_);
+  StopScrubLocked();
+}
+
+void Store::StopScrubLocked() {
+  scrub_stop_.store(true, std::memory_order_relaxed);
+  // Join OUTSIDE scrub_mu_: the loop takes that mutex for its cursor,
+  // and joining while holding it would deadlock a tick that is just
+  // reaching the cursor block (scrub_cfg_mu_ stays held — that is the
+  // point — and the loop never touches it).
+  std::thread t;
+  {
+    std::lock_guard<std::mutex> lock(scrub_mu_);
+    t = std::move(scrub_thread_);
+  }
+  if (t.joinable()) t.join();
+}
+
+void Store::ScrubLoop() {
+  while (!scrub_stop_.load(std::memory_order_relaxed)) {
+    FaultSleepMs(scrub_interval_ms_.load(std::memory_order_relaxed),
+                 &scrub_stop_);
+    if (scrub_stop_.load(std::memory_order_relaxed)) return;
+    // ONE mirror per tick: the scrub rate is bounded by construction
+    // (DDSTORE_SCRUB_MS is the per-mirror cadence, not a duty cycle).
+    std::vector<std::string> mirrors;
+    {
+      std::shared_lock<std::shared_mutex> lock(mu_);
+      for (const auto& kv : vars_)
+        if (!kv.first.empty() && kv.first[0] == '\x01')
+          mirrors.push_back(kv.first);
+    }
+    if (mirrors.empty()) continue;
+    std::string pick;
+    {
+      std::lock_guard<std::mutex> lock(scrub_mu_);
+      auto it = std::upper_bound(mirrors.begin(), mirrors.end(),
+                                 scrub_cursor_);
+      pick = it == mirrors.end() ? mirrors.front() : *it;
+      scrub_cursor_ = pick;
+    }
+    std::string base;
+    int owner = -1;
+    if (ParseMirrorName(pick, &owner, &base))
+      ScrubMirror(pick, base, owner);
+  }
+}
+
+void Store::IntegrityStats(int64_t out[16]) const {
+  out[0] = verify_.load(std::memory_order_relaxed) ? 1 : 0;
+  {
+    std::lock_guard<std::mutex> lock(sums_mu_);
+    out[1] = static_cast<int64_t>(sum_tables_.size());
+  }
+  out[2] = icnt_.sums_computed.load(std::memory_order_relaxed);
+  out[3] = icnt_.sums_rows.load(std::memory_order_relaxed);
+  out[4] = icnt_.sums_served.load(std::memory_order_relaxed);
+  out[5] = icnt_.verified_reads.load(std::memory_order_relaxed);
+  out[6] = icnt_.verified_bytes.load(std::memory_order_relaxed);
+  out[7] = icnt_.mismatches.load(std::memory_order_relaxed);
+  out[8] = icnt_.seq_retries.load(std::memory_order_relaxed);
+  out[9] = icnt_.primary_retries.load(std::memory_order_relaxed);
+  out[10] = icnt_.verify_failovers.load(std::memory_order_relaxed);
+  out[11] = icnt_.corrupt_errors.load(std::memory_order_relaxed);
+  out[12] = icnt_.scrub_rows.load(std::memory_order_relaxed);
+  out[13] = icnt_.scrub_divergent.load(std::memory_order_relaxed);
+  out[14] = icnt_.scrub_repaired.load(std::memory_order_relaxed);
+  out[15] = icnt_.last_corrupt_peer.load(std::memory_order_relaxed);
 }
 
 // -- tenant quotas, shares, accounting ----------------------------------------
@@ -1245,7 +1809,8 @@ void Store::SnapshotCounters(int64_t out[4]) const {
 }
 
 int Store::ReadViaReplica(const std::string& name, int owner,
-                          const std::vector<ReadOp>& ops) {
+                          const std::vector<ReadOp>& ops,
+                          bool verify_bytes) {
   // Snapshot-scoped (and kept-version) reads NEVER fail over: mirrors
   // are registered for the base name only and hold the owner's CURRENT
   // bytes, so serving one would silently violate the version pin.
@@ -1258,6 +1823,7 @@ int Store::ReadViaReplica(const std::string& name, int owner,
   }
   int64_t bytes = 0;
   for (const ReadOp& op : ops) bytes += op.nbytes;
+  bool corrupt_seen = false;
   for (int k = 1; k < replication_; ++k) {
     const int h = (owner - k + world()) % world();
     if (h == owner) break;
@@ -1278,6 +1844,20 @@ int Store::ReadViaReplica(const std::string& name, int owner,
       }
       if (rc == kErrNotFound) continue;  // holder carries no mirror
     }
+    if (rc == kOk && verify_bytes) {
+      // Corruption reroute: this holder's bytes must agree with the
+      // owner's published sums too — a mirror that replicated the
+      // corruption (or rotted independently) must not silently serve.
+      int64_t bad = -1;
+      const int vrc = VerifyOps(name, owner, ops.data(),
+                                static_cast<int64_t>(ops.size()), &bad);
+      if (vrc == kErrCorrupt) {
+        icnt_.mismatches.fetch_add(1, std::memory_order_relaxed);
+        trace::Ev(trace::kVerifyFail, rank(), owner, bad, h);
+        corrupt_seen = true;
+        continue;  // idempotent: the next holder rewrites the same dst
+      }
+    }
     if (rc == kOk) {
       failover_.reads.fetch_add(1, std::memory_order_relaxed);
       failover_.runs.fetch_add(static_cast<int64_t>(ops.size()),
@@ -1291,6 +1871,7 @@ int Store::ReadViaReplica(const std::string& name, int owner,
     }
     return rc;  // fatal (out-of-range against the mirror, ...)
   }
+  if (corrupt_seen) return kErrCorrupt;  // every readable holder disagreed
   // Primary AND every mirror holder gone: the bounded "rows truly
   // lost" signal — elastic.recover is the next rung.
   failover_.replica_giveups.fetch_add(1, std::memory_order_relaxed);
@@ -1301,6 +1882,18 @@ int Store::RemoteRead(const std::string& name,
                       const std::map<int, std::vector<ReadOp>>& by_peer,
                       const std::string& as_tenant) {
   if (by_peer.empty()) return kOk;
+  // Verify hook shared by both branches: re-verify one peer's op list
+  // with a single-peer retried re-read as the ladder's `reread`.
+  auto verify_peer = [&](int peer, const std::vector<ReadOp>& ops) {
+    auto reread = [&, peer]() {
+      PeerReadV rq{peer, ops.data(), static_cast<int64_t>(ops.size())};
+      return RetryTransient(
+          [&]() { return transport_->ReadVMulti(name, &rq, 1, as_tenant); },
+          peer);
+    };
+    return VerifyAfterRead(name, peer, ops.data(),
+                           static_cast<int64_t>(ops.size()), reread);
+  };
   if (replication_ <= 1) {
     // Exactly the pre-replication remote leg: one retried ReadVMulti,
     // kErrPeerLost surfacing unchanged (byte- and counter-identical).
@@ -1310,13 +1903,19 @@ int Store::RemoteRead(const std::string& name,
       reqs.push_back(PeerReadV{kv.first, kv.second.data(),
                                static_cast<int64_t>(kv.second.size())});
     const int target = reqs.size() == 1 ? reqs[0].target : -1;
-    return RetryTransient(
+    int rc = RetryTransient(
         [&]() {
           return transport_->ReadVMulti(name, reqs.data(),
                                         static_cast<int64_t>(reqs.size()),
                                         as_tenant);
         },
         target);
+    if (rc != kOk || !verify_.load(std::memory_order_relaxed)) return rc;
+    for (const auto& kv : by_peer) {
+      rc = verify_peer(kv.first, kv.second);
+      if (rc != kOk) return rc;
+    }
+    return kOk;
   }
   // Failover plan: suspected peers route straight to their replicas
   // (zero deadline burn); the rest issue normally; a kErrPeerLost
@@ -1346,7 +1945,20 @@ int Store::RemoteRead(const std::string& name,
                                         as_tenant);
         },
         target);
-    if (rc == kOk) return kOk;
+    if (rc == kOk) {
+      if (verify_.load(std::memory_order_relaxed)) {
+        // Verify every primary-served list (replica-served ops were
+        // either verified inside the corrupt reroute or are the dead-
+        // owner path, which deliberately serves last-good bytes).
+        for (const PeerReadV& g : go) {
+          auto pit = pending.find(g.target);
+          if (pit == pending.end()) continue;
+          const int vrc = verify_peer(g.target, pit->second);
+          if (vrc != kOk) return vrc;
+        }
+      }
+      return kOk;
+    }
     if (rc != kErrPeerLost) return rc;  // fatal data error / teardown
     int dead = target >= 0 ? target : LastFailedPeer();
     bool named = false;
@@ -1705,6 +2317,34 @@ int Store::Rebind(const std::string& name, void* base) {
   if (v.owned) transport_->FreeShard(name, v.base);
   v.base = static_cast<char*>(base);
   v.owned = false;
+  if (integrity_on_.load(std::memory_order_relaxed) && v.base) {
+    // Recompute unconditionally: the spill path swaps in identical
+    // bytes (same sums), but the elastic-recovery path rebinds a
+    // CHECKPOINT-ROLLED-BACK shard — its sums must describe the
+    // rolled-back bytes before any mirror re-pull or verified read
+    // consults them.
+    std::lock_guard<std::mutex> sl(sums_mu_);
+    integrity::SumTable st;
+    st.sums.resize(static_cast<size_t>(v.nrows));
+    const int64_t rb = v.row_bytes();
+    for (int64_t r = 0; r < v.nrows; ++r)
+      st.sums[static_cast<size_t>(r)] =
+          integrity::RowSum(v.base + r * rb, rb, r, sum_seed_);
+    auto old = sum_tables_.find(name);
+    if (old != sum_tables_.end() && old->second.seq == v.update_seq &&
+        old->second.sums != st.sums) {
+      // Rebind's contract says "identical contents", but the sums
+      // disagree: this is the rollback path. Publish as a NEW content
+      // version, so readers' cached tables and the mirror refresh's
+      // seq gate all see the change — a same-seq swap of different
+      // bytes would read as corruption on every verified read.
+      ++v.update_seq;
+    }
+    st.seq = v.update_seq;
+    sum_tables_[name] = std::move(st);
+    icnt_.sums_computed.fetch_add(1, std::memory_order_relaxed);
+    icnt_.sums_rows.fetch_add(v.nrows, std::memory_order_relaxed);
+  }
   transport_->PublishVar(name, v.base, v.shard_bytes());
   return kOk;
 }
@@ -1746,6 +2386,11 @@ int Store::FreeVar(const std::string& name) {
   // exactly what registration reserved, never a post-hoc recomputation.
   if (reserved_bytes >= 0)
     TenantRelease(TenantOfVarName(name), reserved_bytes);
+  // Integrity tables die with the variable — own table AND every
+  // reader-cache entry (free() is collective, and a re-add restarts at
+  // update_seq 0: a stale cached table at the same seq would read the
+  // new generation's bytes as corruption).
+  DropSumsFor(name);
   return kOk;
 }
 
@@ -1766,6 +2411,11 @@ int Store::FreeAll() {
     kept_bytes_ = 0;
   }
   for (const auto& r : released) TenantRelease(r.first, r.second);
+  {
+    std::lock_guard<std::mutex> lock(sums_mu_);
+    sum_tables_.clear();
+    sum_cache_.clear();
+  }
   return kOk;
 }
 

@@ -29,10 +29,13 @@
 #include <mutex>
 #include <shared_mutex>
 #include <string>
+#include <thread>
+#include <utility>
 #include <vector>
 
 #include "fault.h"
 #include "health.h"
+#include "integrity.h"
 #include "thread_annotations.h"
 
 namespace dds {
@@ -52,11 +55,19 @@ enum ErrorCode : int {
   kErrPeerLost = -10,    // transient-retry budget exhausted against one
                          // peer: the bounded "owner is gone" signal
                          // (fatal — invoke elastic.recover, do not retry)
-  kErrQuota = -11        // tenant byte/var budget exhausted at
+  kErrQuota = -11,       // tenant byte/var budget exhausted at
                          // registration: admission refused. Classified
                          // DISTINCTLY from kErrPeerLost — nothing died,
                          // the tenant is over budget (free vars or raise
                          // the quota; retrying is pointless)
+  kErrCorrupt = -12      // data integrity failure (DDSTORE_VERIFY=1):
+                         // the delivered bytes disagree with the
+                         // owner's published checksums at a STABLE
+                         // content version, a primary re-read and every
+                         // readable replica holder disagree too. Non-
+                         // fatal like kErrQuota — nothing died; the
+                         // Python layer names var + rows + peer and the
+                         // ddtrace flight recorder dumps automatically
 };
 
 const char* ErrorString(int code);
@@ -285,6 +296,26 @@ class Transport {
     (void)target;
     (void)name;
     return -1;
+  }
+
+  // Integrity control op: fetch `count` per-row checksums of `target`'s
+  // shard of `name` starting at owner-local row `row0`, plus the
+  // content version (`seq`) the table was computed at. Rides the same
+  // dedicated control channel as Ping/ReadVarSeq — never a data lane,
+  // never a fault-injector draw (seeded chaos schedules are identical
+  // with verification on or off on the CONTROL side; the verified
+  // DATA re-reads do consume draws, which is why DDSTORE_VERIFY=0 is
+  // the pinned-identical default). Default: unsupported.
+  virtual int ReadRowSums(int target, const std::string& name,
+                          int64_t row0, int64_t count, int64_t* seq,
+                          uint64_t* sums) {
+    (void)target;
+    (void)name;
+    (void)row0;
+    (void)count;
+    (void)seq;
+    (void)sums;
+    return kErrTransport;
   }
 
   // Snapshot-epoch control op: ask `target`'s store to pin (or release)
@@ -528,6 +559,48 @@ class Store {
   // hb_failures, hb_suspects_raised, hb_active, suspected_now].
   void FailoverCounters(int64_t out[16]) const;
 
+  // -- end-to-end data integrity -------------------------------------------
+  //
+  // Per-row 64-bit checksums (integrity.h) computed at Add/Init/Update/
+  // Rebind and served over the control plane; under DDSTORE_VERIFY=1
+  // readers checksum every remote leg's landed bytes against the
+  // owner's table under the served content version. A concurrent
+  // Update mid-read is a clean transient retry (the table refetches at
+  // the new seq); a genuine mismatch retries the primary once, then
+  // reroutes onto the replica chain, and only when every readable
+  // holder disagrees with the published sums does kErrCorrupt surface.
+  // DDSTORE_VERIFY=0 (the default) leaves the whole tree byte-,
+  // error-code- and seeded-fault-counter-identical: no sums are
+  // computed, no control reads issued, no draws consumed.
+
+  // Reader-side verification in force?
+  bool verify_mode() const {
+    return verify_.load(std::memory_order_relaxed);
+  }
+  // Runtime toggles (tests/benches script without env plumbing):
+  // verify -1 keeps / 0 off / 1 on (also enables sum computation);
+  // scrub_ms -1 keeps / 0 stops the scrubber / >0 (re)starts it at
+  // that per-mirror tick interval.
+  int ConfigureIntegrity(int verify, long scrub_ms);
+  // Owner-side sum serve (also the transport's kOpRowSums entry and a
+  // test hook): writes `count` sums of the LOCAL shard of `name`
+  // starting at local row `row0` plus the content version they were
+  // computed at. Builds the table lazily (integrity must be enabled).
+  int RowSums(const std::string& name, int64_t row0, int64_t count,
+              uint64_t* out, int64_t* seq_out);
+  // One synchronous scrub pass over every resident mirror (the
+  // deterministic test/bench hook; the background thread does the same
+  // one mirror per tick). Returns the number of divergent mirrors
+  // found (repairs counted separately), or a negative ErrorCode.
+  int ScrubOnce();
+  // Integrity observability. Layout (keep in sync with binding.py
+  // INTEGRITY_STAT_KEYS): [verify_mode, sums_tables, sums_computed,
+  // sums_rows, sums_served, verified_reads, verified_bytes,
+  // verify_mismatches, verify_seq_retries, verify_primary_retries,
+  // verify_failovers, corrupt_errors, scrub_rows, scrub_divergent,
+  // scrub_repaired, last_corrupt_peer].
+  void IntegrityStats(int64_t out[16]) const;
+
   // -- tenant quotas, shares, accounting ----------------------------------
   //
   // Per-tenant admission control: a byte/var budget checked atomically
@@ -686,9 +759,17 @@ class Store {
                  const std::string& as_tenant = std::string());
   // Serve `owner`'s ops from its replica chain (local mirror memcpy or
   // a remote read of the holder's mirror variable). kErrPeerLost when
-  // every holder is gone or mirrorless.
+  // every holder is gone or mirrorless. `verify_bytes` is the
+  // CORRUPTION reroute (a live primary whose bytes failed
+  // verification): each holder's landed bytes are checksummed against
+  // the owner's published table and a disagreeing holder is skipped —
+  // kErrCorrupt when every readable holder disagrees. The DEAD-owner
+  // path keeps verify_bytes=false: a mirror deliberately serves the
+  // last good (possibly pre-fence) bytes, which current-version sums
+  // would wrongly reject.
   int ReadViaReplica(const std::string& name, int owner,
-                     const std::vector<ReadOp>& ops);
+                     const std::vector<ReadOp>& ops,
+                     bool verify_bytes = false);
   // (Re)register + pull this rank's mirror of `owner`'s shard of
   // `name`, recording `src_seq` as the content version pulled.
   // Chunked row-aligned: transport-read into scratch, then copy under
@@ -698,6 +779,54 @@ class Store {
                  int64_t src_seq);
   // The peer the most recent retry-layer failure named (-1 unknown).
   int LastFailedPeer() const;
+
+  // -- integrity internals -------------------------------------------------
+
+  // Build/refresh the LOCAL shard's sum table if stale (lazy: first
+  // serve after an enable, or after Update dropped a stale table).
+  // Takes the shared registry lock itself — never call under mu_.
+  int EnsureOwnSums(const std::string& name);
+  // Cached fetch of `owner`'s sum table for `name` over the control
+  // plane (`refresh` forces a refetch). `rows` is the owner's shard
+  // row count (from the cum table). False when unavailable (owner
+  // down, integrity off there, unknown var).
+  bool EnsureSumTable(int owner, const std::string& name, int64_t rows,
+                      std::shared_ptr<const integrity::SumTable>* out,
+                      bool refresh);
+  int64_t CachedSumSeq(int owner, const std::string& name) const;
+  void InvalidateSumCache(int owner, const std::string& name);
+  // FreeVar/FreeAll: drop the own table AND every reader-cache entry
+  // of `name` (free is collective — a re-add restarts at seq 0, and a
+  // stale cached table at the same seq would read as corruption).
+  void DropSumsFor(const std::string& name);
+  // Compare `n` landed ops (read from `owner`'s shard of `name`)
+  // against the owner's published sums. kOk = verified;
+  // kErrCorrupt = mismatch (first bad owner-local row in *bad_row);
+  // kErrNotFound = unverifiable (no table / non-row-aligned) — the
+  // caller treats that as a pass, never an error.
+  int VerifyOps(const std::string& name, int owner, const ReadOp* ops,
+                int64_t n, int64_t* bad_row);
+  // The verify → transient-retry → primary-retry → replica →
+  // kErrCorrupt ladder, run after a SUCCESSFUL primary read. `reread`
+  // re-executes that read (already transport-retried). kOk when the
+  // delivered bytes end up verified (possibly re-read or served from a
+  // replica); kErrCorrupt when every readable holder disagrees with
+  // the published sums.
+  int VerifyAfterRead(const std::string& name, int owner,
+                      const ReadOp* ops, int64_t n,
+                      const std::function<int()>& reread);
+  // Scrub machinery: one mirror per call (`base`/`owner` parsed from
+  // the mirror name by the caller); returns 1 if divergent, 0 clean /
+  // skipped, negative on error.
+  int ScrubMirror(const std::string& mname, const std::string& base,
+                  int owner);
+  void ConfigureScrub(long interval_ms);
+  void StopScrub();
+  // The join half, serialized by scrub_cfg_mu_ (two concurrent
+  // configures must never assign over a joinable thread —
+  // std::terminate).
+  void StopScrubLocked() DDS_REQUIRES(scrub_cfg_mu_);
+  void ScrubLoop();
 
   // Pin-aware registry resolution, the single point every read-serving
   // leg (ReadLocal/ReadLocalV/WithShard — local memcpy, CMA fallback,
@@ -772,10 +901,12 @@ class Store {
 
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
-  // Acquired before the CMA registry's mutex: Add/Update/Rebind/Free
-  // publish shard mappings (Transport::PublishVar -> CmaRegistry) while
-  // holding the exclusive lock.
-  mutable std::shared_mutex mu_ DDS_ACQUIRED_BEFORE(CmaRegistry::mu_);
+  // Acquired before the CMA registry's mutex (Add/Update/Rebind/Free
+  // publish shard mappings while holding the exclusive lock) and before
+  // the integrity table mutex (Update/Rebind refresh sums under the
+  // exclusive lock).
+  mutable std::shared_mutex mu_
+      DDS_ACQUIRED_BEFORE(CmaRegistry::mu_, sums_mu_);
   std::map<std::string, VarInfo> vars_ DDS_GUARDED_BY(mu_);
   std::unique_ptr<Transport> transport_;
   bool fence_active_ DDS_GUARDED_BY(mu_) = false;
@@ -855,10 +986,49 @@ class Store {
   std::map<std::string, int64_t> async_tenant_deferred_
       DDS_GUARDED_BY(async_mu_);
 
-  // Heartbeat failure detector + suspect registry. Declared LAST so it
-  // is destroyed FIRST (reverse member order): the ping thread must be
-  // joined before the transport it pings goes away.
+  // -- integrity state -----------------------------------------------------
+  // Reader-side verification on (DDSTORE_VERIFY=1 / ConfigureIntegrity).
+  std::atomic<bool> verify_{false};
+  // Sum computation/serving on (verify, scrub, or runtime enable). One
+  // relaxed load guards every hot-path hook — the off state computes
+  // nothing, fetches nothing, draws nothing.
+  std::atomic<bool> integrity_on_{false};
+  uint64_t sum_seed_ = 0;  // DDSTORE_VERIFY_SEED, resolved at construction
+  // Leaf mutex for the sum tables: control-plane fetches and shard
+  // hashing run OUTSIDE it; only table/cache publication holds it.
+  // Nested under mu_ (Update/Rebind refresh under the exclusive lock)
+  // — never the other way around.
+  mutable std::mutex sums_mu_ DDS_NO_BLOCKING;
+  // Own shards' tables (served over kOpRowSums), keyed by registry name.
+  std::map<std::string, integrity::SumTable> sum_tables_
+      DDS_GUARDED_BY(sums_mu_);
+  // Reader-side cache of peers' tables, keyed (owner, name). shared_ptr
+  // so verification walks a stable snapshot without copying the table.
+  std::map<std::pair<int, std::string>,
+           std::shared_ptr<const integrity::SumTable>>
+      sum_cache_ DDS_GUARDED_BY(sums_mu_);
+  mutable integrity::Counters icnt_;
+
+  // Background scrubber: one resident mirror checked against its
+  // owner's published sums per DDSTORE_SCRUB_MS tick (bounded rate by
+  // construction), divergent mirrors re-pulled with the row-aligned
+  // FillMirror chunking. Stopped (joined) in ~Store BEFORE the health
+  // thread and transport teardown. scrub_cfg_mu_ serializes whole
+  // stop/start transitions (held across the join); scrub_mu_ guards
+  // the thread handle and cursor and is never held while blocking.
+  std::mutex scrub_cfg_mu_ DDS_ACQUIRED_BEFORE(scrub_mu_);
+  std::mutex scrub_mu_;
+  std::atomic<bool> scrub_stop_{false};
+  std::atomic<long> scrub_interval_ms_{0};
+  std::string scrub_cursor_ DDS_GUARDED_BY(scrub_mu_);
+
+  // Heartbeat failure detector + suspect registry. Declared LAST (with
+  // the scrub thread) so it is destroyed FIRST (reverse member order):
+  // the ping thread must be joined before the transport it pings goes
+  // away.
   HealthMonitor health_ DDS_DESTROYED_BEFORE(transport_);
+  std::thread scrub_thread_ DDS_GUARDED_BY(scrub_mu_)
+      DDS_DESTROYED_BEFORE(transport_);
 };
 
 }  // namespace dds

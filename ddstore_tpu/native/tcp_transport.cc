@@ -38,7 +38,12 @@ enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
                      // seeded chaos schedules would shift with the
                      // detector (or a snapshot reader) on.
                      kOpPing = 5, kOpVarSeq = 6,
-                     kOpSnapPin = 7, kOpSnapUnpin = 8 };
+                     kOpSnapPin = 7, kOpSnapUnpin = 8,
+                     // Integrity sum fetch (control plane like the
+                     // three above): req.offset = first owner-local
+                     // row, req.nbytes = row count; response payload =
+                     // [int64 seq][count x uint64 sums].
+                     kOpRowSums = 9 };
 
 #pragma pack(push, 1)
 struct WireReq {
@@ -698,10 +703,20 @@ void TcpTransport::HandleConnection(int fd) {
     // no retry story, and chaos tests target the read paths. One draw
     // per request frame, so a single-threaded request sequence maps to
     // one reproducible fault schedule.
+    uint64_t corrupt_h = 0;  // nonzero = corrupt THIS response's payload
+    int corrupt_n = 0;
     if ((req.op == kOpRead || req.op == kOpReadVec)) {
       FaultInjector& fi = FaultInjector::Get();
       if (fi.enabled()) {
         const FaultDecision fdec = fi.Draw(rank_);
+        if (fdec.kind == FaultKind::kCorrupt) {
+          // Served below through a scratch copy — shard memory itself
+          // is NEVER touched (the corruption is on the wire, which is
+          // exactly what checksum verification must catch; the store's
+          // bytes stay good so a retry/replica read can repair).
+          corrupt_h = fdec.h | 1;
+          corrupt_n = fdec.param_ms;
+        }
         if (fdec.kind == FaultKind::kReset) {
           // Drop the connection before responding: the client's recv
           // sees EOF/ECONNRESET immediately (shutdown, not just return
@@ -764,6 +779,35 @@ void TcpTransport::HandleConnection(int fd) {
       // resp.nbytes carries the update_seq, -1 when unknown.
       WireResp resp{kOk, 0, store_ ? store_->UpdateSeqOf(name) : -1};
       if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+      continue;
+    }
+    if (req.op == kOpRowSums) {
+      // Integrity sum serve: req.offset = first owner-local row,
+      // req.nbytes = count; payload = [int64 seq][count x uint64].
+      // Control plane like kOpPing/kOpVarSeq — deliberately ABOVE the
+      // fault gate's op list, so verification traffic never consumes
+      // data-path draws.
+      constexpr int64_t kMaxSumRows = 1 << 20;
+      WireResp resp{kErrNotFound, 0, 0};
+      std::vector<uint64_t> sums;
+      int64_t seq = -1;
+      if (store_ && req.offset >= 0 && req.nbytes >= 0 &&
+          req.nbytes <= kMaxSumRows) {
+        sums.resize(static_cast<size_t>(req.nbytes));
+        resp.status = store_->RowSums(name, req.offset, req.nbytes,
+                                      sums.data(), &seq);
+      }
+      if (resp.status != kOk) {
+        resp.nbytes = 0;
+        if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+        continue;
+      }
+      resp.nbytes = 8 + static_cast<int64_t>(sums.size()) * 8;
+      iovec iov[3];
+      iov[0] = iovec{&resp, sizeof(resp)};
+      iov[1] = iovec{&seq, sizeof(seq)};
+      iov[2] = iovec{sums.data(), sums.size() * 8};
+      if (SendIov(fd, iov, 3, send_deadline()) != 0) return;
       continue;
     }
     if (req.op == kOpSnapPin || req.op == kOpSnapUnpin) {
@@ -853,6 +897,30 @@ void TcpTransport::HandleConnection(int fd) {
                 if (nb < kPackBytes) packed += nb;
               }
               resp.nbytes = total;
+              if (corrupt_h) {
+                // Injected corruption: the WHOLE payload stages through
+                // one scratch copy (never shard memory) with
+                // deterministic bit-flips applied, then ships as a
+                // well-formed frame — no transport error fires, only
+                // checksum verification can notice.
+                std::vector<char> cbuf(static_cast<size_t>(total));
+                int64_t cpos = 0;
+                for (int64_t i = 0; i < nops; ++i) {
+                  const int64_t off = oplist[2 * i];
+                  const int64_t nb = oplist[2 * i + 1];
+                  if (nb <= 0) continue;
+                  std::memcpy(cbuf.data() + cpos, base + off,
+                              static_cast<size_t>(nb));
+                  cpos += nb;
+                }
+                CorruptBytes(cbuf.data(), total, corrupt_h, corrupt_n);
+                iovec civ[2];
+                civ[0] = iovec{&resp, sizeof(resp)};
+                civ[1] = iovec{cbuf.data(), static_cast<size_t>(total)};
+                if (SendIov(fd, civ, 2, send_deadline()) != 0)
+                  conn_dead = true;
+                return kOk;
+              }
               // Hybrid framing: small ops memcpy into `pack` and CONSECUTIVE
               // packed ops merge into one iovec (the staging area is filled
               // sequentially), big ops go out zero-copy straight from shard
@@ -922,6 +990,19 @@ void TcpTransport::HandleConnection(int fd) {
                 req.nbytes > sb - req.offset)
               return kErrOutOfRange;
             resp.nbytes = req.nbytes;
+            if (corrupt_h && req.nbytes > 0) {
+              // Same scratch-copy corruption as the vectored path.
+              std::vector<char> cbuf(static_cast<size_t>(req.nbytes));
+              std::memcpy(cbuf.data(), base + req.offset,
+                          static_cast<size_t>(req.nbytes));
+              CorruptBytes(cbuf.data(), req.nbytes, corrupt_h, corrupt_n);
+              iovec civ[2];
+              civ[0] = iovec{&resp, sizeof(resp)};
+              civ[1] = iovec{cbuf.data(), static_cast<size_t>(req.nbytes)};
+              if (SendIov(fd, civ, 2, send_deadline()) != 0)
+                conn_dead = true;
+              return kOk;
+            }
             iovec iov[2];
             iov[0] = iovec{&resp, sizeof(resp)};
             iov[1] = iovec{const_cast<char*>(base) + req.offset,
@@ -1128,7 +1209,9 @@ int TcpTransport::EnsureControlConn(PingConn& pc, long timeout_ms) {
 bool TcpTransport::ControlRoundTrip(PingConn& pc, uint32_t op,
                                     const std::string& name,
                                     long timeout_ms, void* resp,
-                                    int64_t tag) {
+                                    int64_t tag, int64_t offset,
+                                    int64_t nbytes, std::string* payload,
+                                    int64_t payload_cap) {
   auto fail = [&]() {
     if (pc.fd >= 0) {
       ::close(pc.fd);
@@ -1143,13 +1226,31 @@ bool TcpTransport::ControlRoundTrip(PingConn& pc, uint32_t op,
   ::setsockopt(pc.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
   ::setsockopt(pc.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   WireReq req{kMagic, op, rank_,
-              static_cast<uint32_t>(name.size()), 0, 0, tag};
+              static_cast<uint32_t>(name.size()), offset, nbytes, tag};
   if (FullSend(pc.fd, &req, sizeof(req)) != 0) return fail();
   if (!name.empty() &&
       FullSend(pc.fd, name.data(), name.size()) != 0)
     return fail();
   if (FullRecv(pc.fd, resp, sizeof(WireResp)) != 0) return fail();
-  if (static_cast<WireResp*>(resp)->status != kOk) return fail();
+  WireResp* r = static_cast<WireResp*>(resp);
+  if (r->status != kOk) {
+    // A WELL-FORMED error response (kErrNotFound from a peer whose
+    // integrity is off, a real snapshot-pin error) leaves the stream
+    // in sync: keep the connection — callers read resp->status. Only
+    // an error frame that ALSO announces a body is a protocol fault.
+    if (payload && r->nbytes != 0) return fail();
+    return true;
+  }
+  if (payload) {
+    // Response body announced in resp.nbytes; an oversized/negative
+    // announcement is a protocol fault and the connection resets (a
+    // partially drained body would desynchronize the next round trip).
+    if (r->nbytes < 0 || r->nbytes > payload_cap) return fail();
+    payload->resize(static_cast<size_t>(r->nbytes));
+    if (r->nbytes > 0 &&
+        FullRecv(pc.fd, &(*payload)[0], payload->size()) != 0)
+      return fail();
+  }
   return true;
 }
 
@@ -1168,7 +1269,8 @@ bool TcpTransport::Ping(int target, long timeout_ms) {
   if (pc.port < 0 || pc.hosts.empty()) return true;
   WireResp resp;
   return ControlRoundTrip(pc, kOpPing, std::string(), timeout_ms,
-                          &resp);
+                          &resp) &&
+         resp.status == kOk;
 }
 
 int64_t TcpTransport::ReadVarSeq(int target, const std::string& name) {
@@ -1178,9 +1280,37 @@ int64_t TcpTransport::ReadVarSeq(int target, const std::string& name) {
   if (pc.port < 0 || pc.hosts.empty()) return -1;
   WireResp resp;
   if (!ControlRoundTrip(pc, kOpVarSeq, name, /*timeout_ms=*/1000,
-                        &resp))
+                        &resp) ||
+      resp.status != kOk)
     return -1;
   return resp.nbytes;
+}
+
+int TcpTransport::ReadRowSums(int target, const std::string& name,
+                              int64_t row0, int64_t count, int64_t* seq,
+                              uint64_t* sums) {
+  if (target < 0 || target >= world_ || target == rank_ || count < 0 ||
+      row0 < 0 || !seq || !sums)
+    return kErrInvalidArg;
+  PingConn& pc = *ping_conns_[target];
+  std::lock_guard<std::mutex> lock(pc.mu);
+  if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
+  WireResp resp;
+  std::string payload;
+  if (!ControlRoundTrip(pc, kOpRowSums, name, /*timeout_ms=*/5000,
+                        &resp, /*tag=*/0, /*offset=*/row0,
+                        /*nbytes=*/count, &payload,
+                        /*payload_cap=*/8 + count * 8))
+    return kErrTransport;
+  // A peer without integrity enabled answers kErrNotFound in-band —
+  // "unverifiable", not a transport fault; the connection stays up.
+  if (resp.status != kOk) return resp.status;
+  if (static_cast<int64_t>(payload.size()) != 8 + count * 8)
+    return kErrTransport;
+  std::memcpy(seq, payload.data(), 8);
+  std::memcpy(sums, payload.data() + 8,
+              static_cast<size_t>(count) * 8);
+  return kOk;
 }
 
 int TcpTransport::SnapshotControl(int target, int64_t snap_id, bool pin,
@@ -1892,6 +2022,19 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     };
     std::vector<CmaTry> tries;
     rest.reserve(static_cast<size_t>(nreqs));
+    // Suspect gate for the same-host leg: a SUSPECTED peer's still-
+    // mapped /dev/shm shard would keep serving bytes silently — masking
+    // the failover the detector just decided on (and, post-recovery,
+    // serving a shard the replacement has rolled back). Route suspected
+    // owners to the wire leaves below, whose per-attempt oracle check
+    // surfaces kErrPeerLost immediately so the store's replica router
+    // takes over. Snapshotted once per batch, same discipline as
+    // ReadVOnRetry.
+    std::function<bool(int)> cma_suspect;
+    {
+      std::lock_guard<std::mutex> lock(oracle_mu_);
+      cma_suspect = suspect_oracle_;
+    }
     for (int64_t ri = 0; ri < nreqs; ++ri) {
       const PeerReadV& rq = reqs[ri];
       CmaPeer* peer = nullptr;
@@ -1908,7 +2051,8 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
       else if (scatter_class)
         want_cma = !RouteScatterViaTcp();
       if (want_cma && rq.target >= 0 && rq.target < world_ &&
-          rq.target != rank_ && rq.n > 0)
+          rq.target != rank_ && rq.n > 0 &&
+          !(cma_suspect && cma_suspect(rq.target)))
         peer = EnsureCmaPeer(*peers_[rq.target], rq.target);
       if (!peer) {
         rest.push_back(rq);

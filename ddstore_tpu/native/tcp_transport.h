@@ -198,6 +198,13 @@ class TcpTransport : public Transport {
   // pulls unconditionally, the safe default.
   int64_t ReadVarSeq(int target, const std::string& name) override
       DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
+  // Integrity sum fetch (kOpRowSums), over the same dedicated control
+  // connection: `count` per-row checksums of the peer's shard starting
+  // at owner-local row `row0`, plus the content version they describe.
+  // Never a data lane, never a fault-injector draw.
+  int ReadRowSums(int target, const std::string& name, int64_t row0,
+                  int64_t count, int64_t* seq, uint64_t* sums) override
+      DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // Snapshot-epoch pin/release, over the same dedicated control
   // connection (never a data lane, no fault-injector draw — seeded
   // chaos schedules are identical with snapshots in play).
@@ -383,14 +390,20 @@ class TcpTransport : public Transport {
   int EnsureControlConn(PingConn& pc, long timeout_ms)
       DDS_REQUIRES(PingConn::mu);
   // One control-plane request/response over the peer's dedicated
-  // connection (the shared body of Ping/ReadVarSeq/SnapshotControl):
-  // sends `op` (+ name for ops that carry one; `tag` rides the frame's
-  // tag field — the snapshot id), receives `resp`. False on any
-  // failure (connection closed for a fresh redial). Caller holds
-  // pc.mu.
+  // connection (the shared body of Ping/ReadVarSeq/SnapshotControl/
+  // ReadRowSums): sends `op` (+ name for ops that carry one; `tag`
+  // rides the frame's tag field — the snapshot id; `offset`/`nbytes`
+  // ride their frame fields — the row-sum range), receives `resp` and,
+  // when `payload` is non-null and the response announces up to
+  // `payload_cap` body bytes, the payload too. False on a TRANSPORT
+  // failure (connection closed for a fresh redial); a well-formed
+  // in-band error keeps the connection and returns true — callers
+  // check resp->status. Caller holds pc.mu.
   bool ControlRoundTrip(PingConn& pc, uint32_t op,
                         const std::string& name, long timeout_ms,
-                        void* resp, int64_t tag = 0)
+                        void* resp, int64_t tag = 0, int64_t offset = 0,
+                        int64_t nbytes = 0, std::string* payload = nullptr,
+                        int64_t payload_cap = 0)
       DDS_REQUIRES(PingConn::mu);
 
   // Store-installed suspect oracle for the leaf retry layer (null =

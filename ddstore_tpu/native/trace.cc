@@ -253,6 +253,8 @@ ScopedOp::~ScopedOp() {
     Flight(kReasonPeerLost, rank_);
   else if (rc_ == kErrQuota)
     Flight(kReasonQuota, rank_);
+  else if (rc_ == kErrCorrupt)
+    Flight(kReasonCorrupt, rank_);
   SetCurrentSpan(prev_);
 }
 

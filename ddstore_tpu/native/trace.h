@@ -72,6 +72,10 @@ enum EventType : uint16_t {
   kLaneBudgetRotate = 18,  // a=budget lanes, b=rotation, c=0
   kFlight = 19,        // flight-recorder marker: a=FlightReason
   kFailover = 20,      // a=dead owner, b=serving holder, c=ops rerouted
+  kVerifyFail = 21,    // checksum mismatch: a=owner, b=first bad local
+                       // row, c=serving holder (-1 = the primary)
+  kScrub = 22,         // one mirror scrubbed: a=rows, b=divergent rows,
+                       // c=1 if re-pulled (repaired)
 };
 
 // Op classes for kOpBegin/kOpEnd `a`. Keep in sync with binding.py
@@ -91,6 +95,7 @@ enum FlightReason : int {
   kReasonWindowGiveup = 3,
   kReasonSuspect = 4,
   kReasonManual = 5,
+  kReasonCorrupt = 6,
 };
 
 // The fixed-size dump record (48 bytes, packed, little-endian on every

@@ -89,6 +89,8 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
             "(default 3)"),
     _k("DDSTORE_HOST", "config"),
     _k("DDSTORE_IFACES", "config"),
+    _k("DDSTORE_INTEGRITY_PHASE_TIMEOUT_S", "config",
+       desc="bench integrity-phase subprocess cap, default 300"),
     _k("DDSTORE_LANES_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_METHOD", "config"),
     _k("DDSTORE_NUM_PROCESSES", "config",
@@ -113,6 +115,10 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_RETRY_BASE_MS", "config"),
     _k("DDSTORE_RETRY_MAX", "config"),
     _k("DDSTORE_SANITIZE", "config"),
+    _k("DDSTORE_SCRUB_MS", "config",
+       desc="background integrity scrubber: one resident mirror "
+            "checked against its owner's published checksums per tick "
+            "(ms), divergent mirrors re-pulled; default 0 (off)"),
     _k("DDSTORE_SCHED", "config",
        desc="0 disables the cost-model scheduler (independent tuners "
             "only); default on"),
@@ -142,6 +148,15 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
        desc="per-thread trace ring capacity in events (default 4096); "
             "overflow overwrites oldest and counts a drop"),
     _k("DDSTORE_UDS", "config"),
+    _k("DDSTORE_VERIFY", "config",
+       desc="1 = checksum-verify every remote read leg against the "
+            "owner's published per-row sums (mismatch -> transient "
+            "seq retry -> one primary retry -> replica chain -> "
+            "ERR_CORRUPT); default 0, pinned byte-, error-code- and "
+            "seeded-fault-counter-identical to the unverified tree"),
+    _k("DDSTORE_VERIFY_SEED", "config",
+       desc="seed of the per-row checksum function (must agree across "
+            "ranks; default 0)"),
     _k("DDSTORE_WORLD", "config"),
 ]}
 

@@ -19,7 +19,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .binding import ERR_PEER_LOST, DDStoreError, NativeStore
+from .binding import (ERR_CORRUPT, ERR_PEER_LOST, DDStoreError,
+                      NativeStore)
 from .rendezvous import (ProcessGroup, SingleGroup, ThreadGroup,
                          auto_group)
 
@@ -456,8 +457,35 @@ class DDStore:
         (``ERR_PEER_LOST`` — the bounded signal the native retry layer
         emits when its budget exhausts against one peer) is augmented
         with WHICH owner died and WHICH requested rows were lost, so the
-        caller can hand exactly that to ``elastic.recover``. Everything
-        else passes through unchanged."""
+        caller can hand exactly that to ``elastic.recover``; a data
+        integrity failure (``ERR_CORRUPT``) is augmented the same way —
+        which owner's bytes disagree with the published checksums and
+        which requested rows are affected (the flight recorder already
+        dumped; nothing died, so elastic.recover is NOT the next step —
+        inspect/rebuild the named shard). Everything else passes
+        through unchanged."""
+        if e.code == ERR_CORRUPT:
+            peer = int(self.integrity_stats().get("last_corrupt_peer",
+                                                  -1))
+            bad = idx
+            try:
+                if peer >= 0:
+                    owners = self.owner_of_rows(name, idx)
+                    bad = idx[owners == peer]
+            except Exception:  # noqa: BLE001 — diagnostics must not mask e
+                pass
+            preview = ", ".join(str(int(r)) for r in bad[:4])
+            more = "..." if len(bad) > 4 else ""
+            holders = (f"and every readable mirror holder "
+                       if self.replication > 1 else "")
+            return DDStoreError(
+                e.code,
+                f"{name}: owner rank {peer} {holders}serve(s) bytes "
+                f"disagreeing with the published checksums at a stable "
+                f"content version; {len(bad)} requested rows affected "
+                f"(rows {preview}{more}) — the delivered batch was NOT "
+                f"silently used; inspect trace_flight_dump() and the "
+                f"named shard")
         if e.code != ERR_PEER_LOST:
             return e
         peer = int(self._native.fault_stats().get("last_error_peer", -1))
@@ -850,6 +878,51 @@ class DDStore:
         gauges; ``DeviceLoader.metrics`` wires this in as
         ``summary()["failover"]``."""
         return self._native.failover_stats()
+
+    # -- end-to-end data integrity -----------------------------------------
+
+    @property
+    def verify_mode(self) -> bool:
+        """Reader-side checksum verification in force
+        (``DDSTORE_VERIFY=1`` or :meth:`integrity_configure`). Off by
+        default — the unverified tree is byte-, error-code- and
+        seeded-fault-counter-identical to the pre-integrity store."""
+        return bool(self._native.integrity_stats().get("verify_mode"))
+
+    def integrity_configure(self, verify: int = -1,
+                            scrub_ms: int = -1) -> None:
+        """Runtime integrity toggles: ``verify`` -1 keeps / 0 off / 1
+        on; ``scrub_ms`` -1 keeps / 0 stops the background scrubber /
+        >0 (re)starts it at that per-mirror tick (load-time:
+        ``DDSTORE_VERIFY`` / ``DDSTORE_SCRUB_MS``)."""
+        self._native.integrity_configure(verify, scrub_ms)
+
+    def integrity_stats(self) -> dict:
+        """Integrity counters (``binding.INTEGRITY_STAT_KEYS``):
+        verified reads/bytes, the mismatch → seq-retry →
+        primary-retry → replica ladder's activity, surfaced
+        ``ERR_CORRUPT`` errors, and the scrubber's
+        checked/divergent/repaired ledger. Monotone except the gauges;
+        ``DeviceLoader.metrics`` wires this in as
+        ``summary()["integrity"]``."""
+        return self._native.integrity_stats()
+
+    def row_sums(self, name: str, row0: int = 0,
+                 count: Optional[int] = None):
+        """This rank's per-row checksum table slice for ``name`` as
+        ``(sums, seq)`` (test/debug hook; the verified-read machinery
+        fetches peers' tables over the control plane itself)."""
+        return self._native.integrity_sums(self._rname(name), row0,
+                                           count)
+
+    def scrub_once(self) -> int:
+        """One synchronous scrub pass over every mirror this rank
+        hosts (the deterministic test/bench hook; ``DDSTORE_SCRUB_MS``
+        runs the same check one mirror per tick in the background).
+        Returns the number of divergent mirrors found; repairs (the
+        row-aligned re-pull) run inline and are counted in
+        :meth:`integrity_stats`."""
+        return self._native.integrity_scrub()
 
     def check_health(self) -> list:
         """Poll the liveness view and fire the peer listeners exactly

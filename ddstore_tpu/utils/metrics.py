@@ -203,6 +203,13 @@ class PipelineMetrics:
         self._trace_counters_source: Optional[Callable[[], Dict]] = None
         self._trace_begin: Optional[Dict] = None
         self._trace_end: Optional[Dict] = None
+        # Integrity source (DDStore.integrity_stats): snapshotted at
+        # epoch boundaries — summary()["integrity"] is how an epoch
+        # record proves "every remote byte verified, N mismatches
+        # caught and repaired, zero silent corruption" on its own.
+        self._integrity_source: Optional[Callable[[], Dict]] = None
+        self._integrity_begin: Optional[Dict] = None
+        self._integrity_end: Optional[Dict] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -398,6 +405,43 @@ class PipelineMetrics:
                 out[k] = max(0, int(v) - int(self._trace_begin.get(k, 0)))
         return out
 
+    #: gauge keys of the integrity source (reported raw, never delta'd
+    #: — keep in sync with binding.INTEGRITY_GAUGE_KEYS).
+    INTEGRITY_GAUGES = ("verify_mode", "sums_tables", "last_corrupt_peer")
+
+    def set_integrity_source(self,
+                             source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning cumulative integrity
+        counters (``DDStore.integrity_stats``). Snapshotted at epoch
+        boundaries; ``summary()["integrity"]`` reports per-epoch deltas
+        (gauges raw)."""
+        self._integrity_source = source
+
+    def _snap_integrity(self) -> Optional[Dict]:
+        if self._integrity_source is None:
+            return None
+        try:
+            return dict(self._integrity_source())
+        except Exception:
+            return None
+
+    def integrity_summary(self) -> Dict:
+        """Per-epoch integrity view: counter deltas + the live gauges."""
+        out: Dict = {}
+        if self._integrity_begin is None:
+            return out
+        end = self._integrity_end if self._integrity_end is not None \
+            else self._snap_integrity()
+        if end is None:
+            return out
+        for k in end:
+            if k in self.INTEGRITY_GAUGES:
+                out[k] = int(end[k])
+            else:
+                out[k] = max(0, int(end[k]) - int(
+                    self._integrity_begin.get(k, 0)))
+        return out
+
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
             -> None:
         """Attach a zero-arg callable returning the cost-model
@@ -539,6 +583,8 @@ class PipelineMetrics:
         self._tenant_end = None
         self._trace_begin = self._snap_trace(begin=True)
         self._trace_end = None
+        self._integrity_begin = self._snap_integrity()
+        self._integrity_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -560,6 +606,7 @@ class PipelineMetrics:
         self._failover_end = self._snap_failover()
         self._tenant_end = self._snap_tenants()
         self._trace_end = self._snap_trace()
+        self._integrity_end = self._snap_integrity()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -627,6 +674,15 @@ class PipelineMetrics:
         # epoch; untraced epochs stay byte-identical.
         if tr and (tr.get("enabled") or tr.get("captured", 0)):
             out["trace"] = tr
+        ig = self.integrity_summary()
+        # Included while verification/scrubbing is in force (an all-zero
+        # mismatch row is the "every byte verified clean" result an
+        # integrity A/B reads) or if any counter moved; unverified
+        # epochs stay byte-identical.
+        if ig and (ig.get("verify_mode")
+                   or any(v for k, v in ig.items()
+                          if k not in self.INTEGRITY_GAUGES)):
+            out["integrity"] = ig
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself

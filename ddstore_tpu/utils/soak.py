@@ -66,6 +66,18 @@ def mmap_soak(rows: int = 100_000_000, batch: int = 65536,
     ``fault_injected`` / ``fault_retries`` / ``fault_giveups`` to the
     result — the "epoch completes byte-identical under transient
     faults" proof at tiering scale.
+
+    A spec containing a ``corrupt:`` arm additionally runs the soak in
+    its INTEGRITY mode: checksum verification is enabled on both ranks
+    (runtime configure — no env plumbing) and the group runs at
+    ``DDSTORE_REPLICATION=2`` so the verify ladder's replica rung can
+    absorb ANY corruption rate (at R=1 a primary whose one retry is
+    also corrupted correctly surfaces ``ERR_CORRUPT`` — honest, but
+    the soak's job is to prove end-to-end REPAIR). Mirrors fill before
+    the injector arms, so they hold clean bytes; note the R×RAM cost
+    at large ``rows``. The byte-identity check then proves: 0
+    give-ups, 0 silent mismatches. Adds ``corrupt_injected`` /
+    ``corrupt_detected`` / ``corrupt_errors`` to the result.
     """
     if fault_spec is not None:
         return _mmap_soak_chaos(rows, batch, nbatches, directory,
@@ -141,6 +153,14 @@ def _mmap_soak_chaos(rows: int, batch: int, nbatches: int,
     paths = [os.path.join(d, f"edges{r}.bin") for r in range(2)]
     name = uuid.uuid4().hex
     stamps = list(range(0, rows, max(1, rows // 63)))[:63] + [rows - 1]
+    # A corrupt: arm needs the verify machinery on BOTH ranks (the
+    # owner serves its sum table, the reader verifies) — otherwise the
+    # flipped bytes would flow silently into the delivered batches and
+    # the byte-identity check would fail by design.
+    corrupt_mode = "corrupt" in fault_spec
+    repl_backup = os.environ.get("DDSTORE_REPLICATION")
+    if corrupt_mode:
+        os.environ["DDSTORE_REPLICATION"] = "2"
     result: dict = {}
     errors: list = []
     done = threading.Event()
@@ -149,6 +169,8 @@ def _mmap_soak_chaos(rows: int, batch: int, nbatches: int,
         try:
             g = ThreadGroup(name, 1, 2)
             with DDStore(g, backend="local") as s1:
+                if corrupt_mode:
+                    s1.integrity_configure(verify=1)
                 s1.add_mmap("edges", paths[1], np.int32, (2,))
                 # Serve until rank 0 finishes; the with-exit close()
                 # pairs with rank 0's (barriers are matched by tag, so
@@ -171,6 +193,8 @@ def _mmap_soak_chaos(rows: int, batch: int, nbatches: int,
         t1.start()
         g0 = ThreadGroup(name, 0, 2)
         with DDStore(g0, backend="local") as s:
+            if corrupt_mode:
+                s.integrity_configure(verify=1)
             rss0 = _vm_rss_mb()
             s.add_mmap("edges", paths[0], np.int32, (2,))
             assert s.total_rows("edges") == rows
@@ -189,6 +213,7 @@ def _mmap_soak_chaos(rows: int, batch: int, nbatches: int,
             fault_configure(fault_spec, fault_seed)
             try:
                 fs0 = s.fault_stats()
+                is0 = s.integrity_stats() if corrupt_mode else {}
                 got = s.get_batch("edges", stamps)
                 ok = bool((got == np.stack([_sentinel(r)
                                             for r in stamps])).all())
@@ -209,6 +234,7 @@ def _mmap_soak_chaos(rows: int, batch: int, nbatches: int,
                         break
                 dt = time.perf_counter() - t0
                 fs = s.fault_stats()
+                is1 = s.integrity_stats() if corrupt_mode else {}
             finally:
                 fault_configure("", 0)
             done.set()
@@ -231,12 +257,27 @@ def _mmap_soak_chaos(rows: int, batch: int, nbatches: int,
                                   - fs0["retry_attempts"]),
                 "fault_giveups": fs["retry_giveups"] - fs0["retry_giveups"],
             }
+            if corrupt_mode:
+                result["corrupt_injected"] = (
+                    fs.get("injected_corrupt", 0)
+                    - fs0.get("injected_corrupt", 0))
+                result["corrupt_detected"] = (
+                    is1.get("verify_mismatches", 0)
+                    - is0.get("verify_mismatches", 0))
+                result["corrupt_errors"] = (
+                    is1.get("corrupt_errors", 0)
+                    - is0.get("corrupt_errors", 0))
         t1.join(60)
         if errors:
             raise RuntimeError(f"chaos soak rank 1 failed: {errors}")
         return result
     finally:
         done.set()
+        if corrupt_mode:
+            if repl_backup is None:
+                os.environ.pop("DDSTORE_REPLICATION", None)
+            else:
+                os.environ["DDSTORE_REPLICATION"] = repl_backup
         if directory is None:
             shutil.rmtree(d, ignore_errors=True)
         else:

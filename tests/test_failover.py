@@ -192,6 +192,58 @@ def test_failover_after_peer_close_tcp(monkeypatch):
         _close_all(stores)
 
 
+def test_cma_leg_gated_on_suspect_oracle(monkeypatch):
+    """ISSUE 11 satellite (the CMA-masks-failover gap): a SUSPECTED
+    peer's still-mapped /dev/shm shard must not keep serving silently.
+    With the gate, the CMA leg skips suspected owners, the wire leaf's
+    oracle check surfaces kErrPeerLost immediately, and at R=1 the
+    classified error reaches the caller instead of stale-but-plausible
+    bytes. Pre-gate, this read SUCCEEDED via the mapped shm — exactly
+    the masking the failover bench had to force DDSTORE_CMA=0 for."""
+    _set_budgets(monkeypatch, replication=1)
+    monkeypatch.setenv("DDSTORE_CMA", "1")
+    stores = _build_stores(2, "tcp", rows=8)
+    try:
+        s0 = stores[0]
+        idx = np.arange(8, 16)
+        want = np.full((8, 4), 2.0)
+        np.testing.assert_array_equal(s0.get_batch("v", idx), want)
+        # The gate test is vacuous unless the fast path actually engaged.
+        assert s0.cma_ops > 0
+        s0.mark_suspect(1)
+        with pytest.raises(DDStoreError) as ei:
+            s0.get_batch("v", idx)
+        assert ei.value.code == ERR_PEER_LOST
+        # Un-suspecting restores the fast path (the peer is alive).
+        s0.mark_suspect(1, suspected=False)
+        np.testing.assert_array_equal(s0.get_batch("v", idx), want)
+    finally:
+        _close_all(stores)
+
+
+def test_failover_with_cma_enabled(monkeypatch):
+    """ISSUE 11 satellite, replica half: with the CMA fast path ON and
+    R=2, a suspected owner's rows route to the replica chain on every
+    leg — the still-mapped shm no longer masks failover, and the bytes
+    stay correct because the mirror holds the owner's exact shard."""
+    _set_budgets(monkeypatch, replication=2, heartbeat_ms=0)
+    monkeypatch.setenv("DDSTORE_CMA", "1")
+    stores = _build_stores(3, "tcp", rows=8)
+    try:
+        s0 = stores[0]
+        idx, want = _expect(stores, 8, 3)
+        np.testing.assert_array_equal(s0.get_batch("v", idx), want)
+        assert s0.cma_ops > 0
+        fo0 = s0.failover_stats()
+        s0.mark_suspect(1)
+        np.testing.assert_array_equal(s0.get_batch("v", idx), want)
+        fo = s0.failover_stats()
+        assert fo["suspect_skips"] > fo0["suspect_skips"]
+        assert fo["failover_reads"] > fo0["failover_reads"]
+    finally:
+        _close_all(stores)
+
+
 def test_peer_lost_only_when_all_holders_gone(monkeypatch):
     """kErrPeerLost now means the whole replica set is gone: with R=2
     and BOTH the owner and its mirror holder dead, the classified error

@@ -1,0 +1,653 @@
+"""End-to-end data integrity (ISSUE 11): checksummed shards, verified
+reads, corruption injection, and replica-backed scrub & repair.
+
+Pinned contracts:
+
+* ``DDSTORE_VERIFY=0`` (default) is byte-, error-code- and
+  seeded-fault-COUNTER-identical to the pre-integrity tree — sum
+  computation alone (scrub enabled, verify off) must not shift a
+  seeded chaos schedule by a single draw.
+* The ``corrupt:p[:nbytes]`` injector arm is deterministic like the
+  existing arms: same (spec, seed, read sequence) -> identical draw
+  and corruption counters.
+* With verify ON: injected corruption is detected on EVERY delivered
+  byte; over R=2 the replica rung serves byte-identical batches with
+  0 give-ups; ``ERR_CORRUPT`` (-12) surfaces ONLY when every readable
+  holder disagrees with the published sums, names var+rows+peer, and
+  dumps the ddtrace flight recorder.
+* A concurrent ``update()`` mid-read is a clean transient retry, never
+  a corruption verdict.
+* The scrubber repairs divergent mirrors, never "repairs" a
+  legitimately stale mirror or a deliberately older snapshot KEPT
+  copy, and ``rebind()`` (the elastic rollback vehicle) recomputes
+  sums before mirrors can re-pull.
+
+tier1_required: local + in-process TCP backends only, no accelerator.
+"""
+
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStore, DDStoreError, ThreadGroup, fault_configure
+from ddstore_tpu.binding import (ERR_CORRUPT, INTEGRITY_STAT_KEYS,
+                                 trace_configure, trace_flight_dump,
+                                 trace_reset)
+from ddstore_tpu.rendezvous import SingleGroup
+
+pytestmark = pytest.mark.tier1_required
+
+_BUDGETS = {
+    "DDSTORE_CONNECT_TIMEOUT_S": "1",
+    "DDSTORE_READ_TIMEOUT_S": "2",
+    "DDSTORE_RETRY_MAX": "2",
+    "DDSTORE_RETRY_BASE_MS": "1",
+    "DDSTORE_OP_DEADLINE_S": "5",
+    "DDSTORE_BARRIER_TIMEOUT_S": "20",
+}
+
+
+def _set_budgets(monkeypatch, replication=1, **extra):
+    for k, v in _BUDGETS.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("DDSTORE_REPLICATION", str(replication))
+    monkeypatch.setenv("DDSTORE_HEARTBEAT_MS", "0")
+    for k, v in extra.items():
+        monkeypatch.setenv(k, v)
+
+
+def _build_stores(world, backend, rows=8, dim=4, verify=True,
+                  stamp=None):
+    """One DDStore per rank over a ThreadGroup; shards rank-stamped
+    (rank+1) unless ``stamp`` overrides. Verification is enabled at
+    runtime BEFORE add so registration computes the sum tables."""
+    name = uuid.uuid4().hex
+    stores = {}
+    errs = []
+
+    def worker(rank):
+        try:
+            g = ThreadGroup(name, rank, world)
+            s = DDStore(g, backend=backend)
+            if verify:
+                s.integrity_configure(verify=1)
+            val = float(rank + 1) if stamp is None else stamp(rank)
+            s.add("v", np.full((rows, dim), val, np.float64))
+            stores[rank] = s
+        except Exception as e:  # noqa: BLE001
+            errs.append((rank, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=(r,))
+          for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+    assert len(stores) == world
+    return stores
+
+
+def _close_all(stores):
+    for s in stores.values():
+        s._native.close()
+
+
+# ---------------------------------------------------------------------------
+# Sum tables.
+# ---------------------------------------------------------------------------
+
+def test_row_sums_computed_versioned_and_salted(monkeypatch):
+    """Per-row sums exist at add, refresh at update (partial — only the
+    touched rows change), carry the content version, and are salted by
+    the row index (equal-content rows hash differently, so a
+    right-bytes-wrong-row serve fails verification)."""
+    _set_budgets(monkeypatch)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.integrity_configure(verify=1)
+        # Equal-content rows: the index salt must separate them.
+        s.add("v", np.zeros((8, 4), np.float32))
+        sums, seq = s.row_sums("v")
+        assert seq == 0 and len(sums) == 8
+        assert len(set(sums.tolist())) == 8
+        s.update("v", np.full((2, 4), 9.0, np.float32), row_offset=3)
+        sums2, seq2 = s.row_sums("v")
+        assert seq2 == 1
+        assert sums2[3] != sums[3] and sums2[4] != sums[4]
+        untouched = [i for i in range(8) if i not in (3, 4)]
+        assert (sums2[untouched] == sums[untouched]).all()
+        st = s.integrity_stats()
+        assert set(st) == set(INTEGRITY_STAT_KEYS)
+        assert st["verify_mode"] == 1 and st["sums_tables"] >= 1
+
+
+def test_row_sums_refused_while_integrity_off(monkeypatch):
+    _set_budgets(monkeypatch)
+    monkeypatch.delenv("DDSTORE_VERIFY", raising=False)
+    monkeypatch.delenv("DDSTORE_SCRUB_MS", raising=False)
+    with DDStore(SingleGroup(), backend="local") as s:
+        s.add("v", np.zeros((4, 4), np.float32))
+        assert not s.verify_mode
+        assert s.integrity_stats()["sums_tables"] == 0
+        with pytest.raises(DDStoreError):
+            s.row_sums("v")
+
+
+def test_sums_deterministic_across_stores(monkeypatch):
+    """Same bytes + same seed -> same table on independent stores (the
+    property cross-rank verification rests on)."""
+    _set_budgets(monkeypatch)
+    data = np.arange(64, dtype=np.float64).reshape(8, 8)
+    got = []
+    for _ in range(2):
+        with DDStore(SingleGroup(), backend="local") as s:
+            s.integrity_configure(verify=1)
+            s.add("v", data)
+            got.append(s.row_sums("v")[0].copy())
+    assert (got[0] == got[1]).all()
+
+
+# ---------------------------------------------------------------------------
+# DDSTORE_VERIFY=0 identity (the default tree is untouched).
+# ---------------------------------------------------------------------------
+
+def test_verify_off_seeded_fault_counters_identical(monkeypatch):
+    """Sum computation alone (integrity on, verify OFF — the scrub
+    configuration) must not consume a single injector draw or change a
+    delivered byte: the seeded chaos schedule and the fetched bytes are
+    bit-identical to a fully-disabled run."""
+    _set_budgets(monkeypatch)
+
+    def run(enable_sums):
+        name = uuid.uuid4().hex
+        out = {}
+        errs = []
+        done = threading.Event()
+
+        def rank1():
+            try:
+                g = ThreadGroup(name, 1, 2)
+                with DDStore(g, backend="local") as s1:
+                    if enable_sums:
+                        s1.integrity_configure(verify=1)
+                        s1.integrity_configure(verify=0)  # sums stay on
+                    s1.add("v", np.full((16, 4), 2.0, np.float64))
+                    done.wait(60)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+                done.set()
+
+        t = threading.Thread(target=rank1, daemon=True)
+        t.start()
+        g0 = ThreadGroup(name, 0, 2)
+        with DDStore(g0, backend="local") as s:
+            if enable_sums:
+                s.integrity_configure(verify=1)
+                s.integrity_configure(verify=0)
+            s.add("v", np.full((16, 4), 1.0, np.float64))
+            assert not s.verify_mode
+            fault_configure("reset:0.3,delay:0.1:1", seed=21)
+            try:
+                batches = [s.get_batch("v", np.arange(16, 32)).copy()
+                           for _ in range(6)]
+                fs = s.fault_stats()
+            finally:
+                fault_configure("", 0)
+            out["batches"] = batches
+            out["checks"] = fs["fault_checks"]
+            out["reset"] = fs["injected_reset"]
+            out["retries"] = fs["retry_attempts"]
+            done.set()
+        t.join(30)
+        assert not errs, errs
+        return out
+
+    a = run(enable_sums=False)
+    b = run(enable_sums=True)
+    assert a["checks"] == b["checks"] > 0
+    assert a["reset"] == b["reset"]
+    assert a["retries"] == b["retries"]
+    for x, y in zip(a["batches"], b["batches"]):
+        np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# The corrupt: injector arm.
+# ---------------------------------------------------------------------------
+
+def test_corrupt_spec_parsing(monkeypatch):
+    _set_budgets(monkeypatch)
+    fault_configure("corrupt:0.5", seed=1)       # default nbytes
+    fault_configure("corrupt:0.5:4", seed=1)     # explicit nbytes
+    fault_configure("corrupt:0.1,reset:0.1", 1)  # composes with others
+    with pytest.raises(DDStoreError):
+        fault_configure("corrupt:1.5", seed=1)   # p > 1
+    with pytest.raises(DDStoreError):
+        fault_configure("corrupt:0.1:-3", 1)     # negative param
+    with pytest.raises(DDStoreError):
+        fault_configure("corrupt", seed=1)       # missing probability
+    fault_configure("", 0)
+
+
+def test_corrupt_draws_deterministic(monkeypatch):
+    """Two identical seeded runs produce identical draw AND corruption
+    counters — the determinism contract of every injector arm."""
+    _set_budgets(monkeypatch)
+
+    def run():
+        name = uuid.uuid4().hex
+        stores = {}
+        errs = []
+
+        def worker(rank):
+            try:
+                g = ThreadGroup(name, rank, 2)
+                s = DDStore(g, backend="local")
+                s.add("v", np.full((16, 4), rank + 1.0, np.float64))
+                stores[rank] = s
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(60) for t in ts]
+        assert not errs, errs
+        s = stores[0]
+        fault_configure("corrupt:0.5:4", seed=33)
+        try:
+            outs = [s.get_batch("v", np.arange(16, 32)).copy()
+                    for _ in range(8)]
+            fs = s.fault_stats()
+        finally:
+            fault_configure("", 0)
+            _close_all(stores)
+        return outs, fs["fault_checks"], fs["injected_corrupt"]
+
+    o1, c1, k1 = run()
+    o2, c2, k2 = run()
+    assert (c1, k1) == (c2, k2)
+    assert k1 > 0  # the arm actually fired
+    # Verification is OFF here: the corrupted bytes flow through, and
+    # determinism means they flow through IDENTICALLY.
+    for a, b in zip(o1, o2):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Verified reads: detection, ladder, classification, hygiene.
+# ---------------------------------------------------------------------------
+
+def test_corrupt_detected_r1_raises_classified(monkeypatch):
+    """R=1 + persistent corruption: mismatch -> stable-seq check -> one
+    primary retry (also corrupt) -> no replicas -> ERR_CORRUPT naming
+    var + rows + peer, with an automatic flight-recorder dump; a clean
+    read afterwards succeeds (nothing died, nothing latched)."""
+    _set_budgets(monkeypatch)
+    stores = _build_stores(2, "local", rows=16, verify=True)
+    trace_configure(1)
+    trace_reset()
+    try:
+        s = stores[0]
+        idx = np.arange(16, 24)
+        np.testing.assert_array_equal(s.get_batch("v", idx),
+                                      np.full((8, 4), 2.0))
+        fault_configure("corrupt:1.0", seed=3, ranks=[1])
+        try:
+            with pytest.raises(DDStoreError) as ei:
+                s.get_batch("v", idx)
+        finally:
+            fault_configure("", 0)
+        assert ei.value.code == ERR_CORRUPT
+        msg = str(ei.value)
+        assert "v:" in msg and "rank 1" in msg and "checksums" in msg
+        assert "rows 16" in msg
+        st = s.integrity_stats()
+        assert st["corrupt_errors"] >= 1
+        assert st["verify_mismatches"] >= 2  # first + primary retry
+        assert st["verify_primary_retries"] >= 1
+        assert st["last_corrupt_peer"] == 1
+        # Non-fatal class: the store serves clean bytes right after.
+        np.testing.assert_array_equal(s.get_batch("v", idx),
+                                      np.full((8, 4), 2.0))
+        # Flight recorder dumped automatically with the corrupt reason.
+        flight = trace_flight_dump()
+        assert len(flight) > 0
+        markers = flight[flight["type"] == 19]  # kFlight
+        assert 6 in set(int(a) for a in markers["a"])  # kReasonCorrupt
+    finally:
+        trace_configure(0)
+        _close_all(stores)
+
+
+def test_corrupt_async_read_releases_ticket(monkeypatch):
+    """Ticket hygiene: a verify-failed async read raises ERR_CORRUPT
+    from wait() and still releases its ticket (async_pending()==0)."""
+    _set_budgets(monkeypatch)
+    stores = _build_stores(2, "local", rows=16, verify=True)
+    try:
+        s = stores[0]
+        fault_configure("corrupt:1.0", seed=5, ranks=[1])
+        try:
+            h = s.get_batch_async("v", np.arange(16, 24))
+            with pytest.raises(DDStoreError) as ei:
+                h.wait()
+            assert ei.value.code == ERR_CORRUPT
+        finally:
+            fault_configure("", 0)
+        assert s.async_pending() == 0
+    finally:
+        _close_all(stores)
+
+
+def test_corrupt_repaired_via_replica_r2(monkeypatch):
+    """R=2 + 100% corruption at the owner's serve path: the verify
+    ladder reroutes onto the replica chain, the mirror's clean bytes
+    are themselves verified, and every delivered batch is
+    byte-identical — 0 give-ups, 0 ERR_CORRUPT."""
+    _set_budgets(monkeypatch, replication=2, DDSTORE_CMA="0")
+    stores = _build_stores(3, "tcp", rows=8, verify=True)
+    try:
+        s = stores[0]
+        idx = np.arange(3 * 8)
+        want = (idx // 8 + 1)[:, None] * np.ones((1, 4))
+        np.testing.assert_array_equal(s.get_batch("v", idx), want)
+        is0 = s.integrity_stats()
+        fs0 = s.fault_stats()
+        fault_configure("corrupt:1.0", seed=7, ranks=[1])
+        try:
+            for _ in range(4):
+                np.testing.assert_array_equal(s.get_batch("v", idx),
+                                              want)
+            # Snapshot BEFORE disarming: fault_configure resets the
+            # process-global injector counters.
+            fs = s.fault_stats()
+        finally:
+            fault_configure("", 0)
+        st = s.integrity_stats()
+        assert fs["injected_corrupt"] > fs0["injected_corrupt"]
+        assert st["verify_mismatches"] > is0["verify_mismatches"]
+        assert st["verify_failovers"] > is0["verify_failovers"]
+        assert st["corrupt_errors"] == is0["corrupt_errors"]
+        assert fs["retry_giveups"] == fs0["retry_giveups"]
+        # Corruption is not death: the owner stays unsuspected (its
+        # control plane and shard are fine; only its data serves rot).
+        assert s.suspected_peers() == []
+    finally:
+        _close_all(stores)
+
+
+def test_corrupt_error_only_when_all_holders_disagree(monkeypatch):
+    """The kErrCorrupt boundary at R=2: owner 2's whole readable chain
+    ([2, 1] — both serve over the corrupting wire) raises ERR_CORRUPT,
+    while owner 1's rows (holder = rank 0's own LOCAL mirror, no wire)
+    still repair transparently in the same session."""
+    _set_budgets(monkeypatch, replication=2, DDSTORE_CMA="0")
+    stores = _build_stores(3, "tcp", rows=8, verify=True)
+    try:
+        s = stores[0]
+        fault_configure("corrupt:1.0", seed=13, ranks=[1, 2])
+        try:
+            with pytest.raises(DDStoreError) as ei:
+                s.get_batch("v", np.arange(16, 24))
+            assert ei.value.code == ERR_CORRUPT
+            assert "rank 2" in str(ei.value)
+            got = s.get_batch("v", np.arange(8, 16))
+            np.testing.assert_array_equal(got, np.full((8, 4), 2.0))
+        finally:
+            fault_configure("", 0)
+        st = s.integrity_stats()
+        assert st["corrupt_errors"] >= 1
+        assert st["verify_failovers"] >= 1
+    finally:
+        _close_all(stores)
+
+
+def test_concurrent_update_is_transient_never_corrupt(monkeypatch):
+    """A writer updating its shard while a verified reader loops must
+    never produce a corruption verdict: a seq mismatch is a clean
+    transient (table refetch + re-read), and every delivered row is a
+    consistent version."""
+    _set_budgets(monkeypatch)
+    stores = _build_stores(2, "local", rows=32, dim=8, verify=True)
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        try:
+            k = 0
+            while not stop.is_set():
+                k += 1
+                stores[1].update(
+                    "v", np.full((32, 8), 2.0 + k, np.float64))
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        s = stores[0]
+        idx = np.arange(32, 64)
+        deadline = time.monotonic() + 3.0
+        reads = 0
+        while time.monotonic() < deadline:
+            got = s.get_batch("v", idx)
+            # Every row is a single consistent version (>= 2.0).
+            assert (got.min(axis=1) == got.max(axis=1)).all()
+            assert (got >= 2.0).all()
+            reads += 1
+        assert reads > 0
+        st = s.integrity_stats()
+        assert st["corrupt_errors"] == 0, st
+    finally:
+        stop.set()
+        t.join(30)
+        assert not errs, errs
+        _close_all(stores)
+
+
+# ---------------------------------------------------------------------------
+# Scrub & repair.
+# ---------------------------------------------------------------------------
+
+def _build_with_corrupt_fill(monkeypatch, world=2, rows=8):
+    """R=2 TCP stores whose mirror of rank 1 filled through a
+    corrupting serve path (verify OFF during add so the bad fill
+    lands), verification enabled afterwards."""
+    _set_budgets(monkeypatch, replication=2, DDSTORE_CMA="0")
+    name = uuid.uuid4().hex
+    stores = {}
+    errs = []
+    armed = threading.Barrier(world)
+
+    def worker(rank):
+        try:
+            g = ThreadGroup(name, rank, world)
+            s = DDStore(g, backend="tcp")
+            if rank == 0:
+                fault_configure("corrupt:1.0", seed=9, ranks=[1])
+            armed.wait(30)
+            s.add("v", np.full((rows, 16), rank + 1.0, np.float64))
+            stores[rank] = s
+        except Exception as e:  # noqa: BLE001
+            errs.append((rank, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=(r,))
+          for r in range(world)]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    fault_configure("", 0)
+    assert not errs, errs
+    for s in stores.values():
+        s.integrity_configure(verify=1)
+    return stores
+
+
+def test_scrub_detects_and_repairs_divergent_mirror(monkeypatch):
+    stores = _build_with_corrupt_fill(monkeypatch)
+    try:
+        s0 = stores[0]  # holds the (corrupt) mirror of owner 1
+        divergent = s0.scrub_once()
+        st = s0.integrity_stats()
+        assert divergent >= 1
+        assert st["scrub_divergent"] >= 1
+        assert st["scrub_repaired"] >= 1
+        assert st["scrub_rows"] >= 8
+        # Second pass: clean (the repair pulled verified bytes).
+        assert s0.scrub_once() == 0
+        # The repaired mirror serves correct failover bytes.
+        s0.mark_suspect(1)
+        got = s0.get_batch("v", np.arange(8, 16))
+        np.testing.assert_array_equal(got, np.full((8, 16), 2.0))
+    finally:
+        _close_all(stores)
+
+
+def test_background_scrubber_thread_repairs(monkeypatch):
+    """The DDSTORE_SCRUB_MS thread does the same work unattended (one
+    mirror per tick, bounded rate) and is joined cleanly at close."""
+    stores = _build_with_corrupt_fill(monkeypatch)
+    try:
+        s0 = stores[0]
+        s0.integrity_configure(scrub_ms=20)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if s0.integrity_stats()["scrub_repaired"] >= 1:
+                break
+            time.sleep(0.05)
+        st = s0.integrity_stats()
+        assert st["scrub_repaired"] >= 1, st
+        s0.integrity_configure(scrub_ms=0)  # stop + join
+    finally:
+        _close_all(stores)
+
+
+def test_scrub_skips_stale_mirror_and_kept_snapshot(monkeypatch):
+    """Version discipline: an owner that updated since the fence makes
+    its mirror legitimately STALE — scrub must not flag or 'repair' it
+    (the next fence re-pulls); and a snapshot's deliberately older KEPT
+    copy is never scrub's business (it walks \\x01 mirrors only), so a
+    pinned snapshot read stays byte-stable across update + scrub."""
+    _set_budgets(monkeypatch, replication=2)
+    stores = _build_stores(2, "local", rows=8, verify=True)
+    try:
+        s0, s1 = stores[0], stores[1]
+        # Pin a snapshot of the current version on every rank.
+        snap = s0.attach("eval", snapshot=True)
+        # Owner 1 updates — its mirror on rank 0 is now stale, and a
+        # kept copy of the pinned version materializes on rank 1.
+        s1.update("v", np.full((8, 4), 7.0, np.float64))
+        st0 = s0.integrity_stats()
+        assert s0.scrub_once() == 0  # stale != divergent
+        st = s0.integrity_stats()
+        assert st["scrub_divergent"] == st0["scrub_divergent"]
+        assert st["scrub_repaired"] == st0["scrub_repaired"]
+        # The snapshot still serves the PINNED bytes (kept copy; the
+        # \x03 name is exempt from current-seq verification).
+        got = snap.get_batch("v", np.arange(8, 16))
+        np.testing.assert_array_equal(got, np.full((8, 4), 2.0))
+        # Current reads see the new bytes, verified.
+        got = s0.get_batch("v", np.arange(8, 16))
+        np.testing.assert_array_equal(got, np.full((8, 4), 7.0))
+        snap.detach()
+        # After the epoch fence refreshes the mirror, scrub stays clean.
+        for method in ("epoch_begin", "epoch_end"):
+            fts = [threading.Thread(target=getattr(s, method))
+                   for s in stores.values()]
+            [t.start() for t in fts]
+            [t.join(30) for t in fts]
+            assert not any(t.is_alive() for t in fts)
+        assert s0.scrub_once() == 0
+    finally:
+        _close_all(stores)
+
+
+def test_rebind_recomputes_sums_for_rolled_back_shard(monkeypatch):
+    """The elastic-rollback vehicle: rebind() swapping DIFFERENT bytes
+    at the same content version must republish sums (and a new version)
+    so verified reads and mirror refreshes see the rollback instead of
+    reading it as corruption."""
+    _set_budgets(monkeypatch, replication=2)
+    stores = _build_stores(2, "local", rows=8, verify=True)
+    keep_alive = []
+    try:
+        s0, s1 = stores[0], stores[1]
+        orig = np.full((8, 4), 2.0, np.float64)
+        s1.update("v", np.full((8, 4), 9.0, np.float64))
+        sums_new, seq_new = s1.row_sums("v")
+        assert seq_new == 1
+        # "Roll back" rank 1's shard to the original bytes (what
+        # elastic rejoin does from the checkpoint).
+        rolled = orig.copy()
+        keep_alive.append(rolled)  # rebind borrows the buffer
+        s1._native.rebind("v", rolled)
+        sums_rb, seq_rb = s1.row_sums("v")
+        assert (sums_rb != sums_new).any()
+        assert seq_rb != seq_new  # republished as a NEW version
+        # Verified remote reads of the rolled-back shard pass.
+        got = s0.get_batch("v", np.arange(8, 16))
+        np.testing.assert_array_equal(got, orig)
+        assert s0.integrity_stats()["corrupt_errors"] == 0
+        # Mirrors re-pull the rolled-back bytes (elastic's forced
+        # refresh), verified against the recomputed sums.
+        s0.refresh_mirrors()
+        s0.mark_suspect(1)
+        got = s0.get_batch("v", np.arange(8, 16))
+        np.testing.assert_array_equal(got, orig)
+    finally:
+        _close_all(stores)
+
+
+# ---------------------------------------------------------------------------
+# Soak corrupt mode + metrics plumbing.
+# ---------------------------------------------------------------------------
+
+def test_soak_corrupt_mode(monkeypatch):
+    """utils/soak.py integrity mode: every delivered batch verified
+    against the backing files under injected corruption — 0 give-ups,
+    0 silent mismatches, 0 ERR_CORRUPT (R=2 absorbs any rate)."""
+    _set_budgets(monkeypatch)
+    from ddstore_tpu.utils.soak import mmap_soak
+
+    m = mmap_soak(rows=100_000, batch=2048, nbatches=12,
+                  fault_spec="corrupt:0.3", fault_seed=11)
+    assert m["faults_ok"], m
+    assert m["fault_giveups"] == 0
+    assert m["corrupt_injected"] > 0
+    assert m["corrupt_detected"] > 0
+    assert m["corrupt_errors"] == 0
+    assert m["sentinels_ok"]
+
+
+def test_metrics_integrity_summary_deltas():
+    """PipelineMetrics.set_integrity_source: per-epoch deltas for the
+    monotone counters, gauges raw, inert (absent) when nothing moved
+    and verification is off."""
+    from ddstore_tpu.utils.metrics import PipelineMetrics
+
+    state = {"verify_mode": 1, "sums_tables": 3, "verified_reads": 10,
+             "verified_bytes": 1 << 20, "verify_mismatches": 1,
+             "corrupt_errors": 0, "last_corrupt_peer": -1}
+    m = PipelineMetrics()
+    m.set_integrity_source(lambda: dict(state))
+    m.epoch_start()
+    state.update(verified_reads=25, verified_bytes=3 << 20,
+                 verify_mismatches=2)
+    m.epoch_end()
+    ig = m.summary()["integrity"]
+    assert ig["verified_reads"] == 15
+    assert ig["verified_bytes"] == 2 << 20
+    assert ig["verify_mismatches"] == 1
+    assert ig["verify_mode"] == 1 and ig["sums_tables"] == 3
+    assert ig["last_corrupt_peer"] == -1
+    # Verify off + nothing moved -> no "integrity" key at all.
+    state2 = {"verify_mode": 0, "sums_tables": 0, "verified_reads": 0,
+              "last_corrupt_peer": -1}
+    m2 = PipelineMetrics()
+    m2.set_integrity_source(lambda: dict(state2))
+    m2.epoch_start()
+    m2.epoch_end()
+    assert "integrity" not in m2.summary()

@@ -299,6 +299,65 @@ ts = [threading.Thread(target=tenant_worker, args=(r, errs))
 [t.start() for t in ts]
 [t.join() for t in ts]
 assert not errs, errs
+
+# Integrity verify-fail/repair paths under the sanitizer (ISSUE 11
+# satellite): per-row sum tables built/fetched concurrently, 100%
+# injected payload corruption driving the whole ladder — bracketed
+# re-reads, the replica-rung repair (owner 1's rows: rank 0's own
+# mirror serves clean), AND the kErrCorrupt give-up (owner 2's rows:
+# its only other holder, rank 1, corrupts too) — with a verify-failed
+# ASYNC read still releasing its ticket (async_pending()==0), plus a
+# scrub pass hashing mirrors while traffic flows.
+os.environ["DDSTORE_REPLICATION"] = "2"
+os.environ["DDSTORE_CMA"] = "0"
+os.environ["DDSTORE_RETRY_MAX"] = "2"
+INTGNAME = uuid.uuid4().hex
+IROWS, IDIM = 8, 1 << 9  # small: the sanitizer cost is in the paths,
+#                          not the bytes, and tier-1 runs this twice
+
+intg_ready = threading.Barrier(3)
+intg_done = threading.Barrier(3)
+
+def intg_worker(rank, errs):
+    try:
+        group = ThreadGroup(INTGNAME, rank, 3)
+        with DDStore(group, backend="tcp") as s:
+            s.integrity_configure(verify=1)
+            s.add("v", np.full((IROWS, IDIM), rank + 1.0, np.float64))
+            intg_ready.wait()
+            if rank == 0:
+                idx1 = np.arange(IROWS, 2 * IROWS)      # owner 1
+                idx2 = np.arange(2 * IROWS, 3 * IROWS)  # owner 2
+                fault_configure("corrupt:1.0", seed=17, ranks=[1, 2])
+                try:
+                    # Repair path: primary corrupt, rank 0's local
+                    # mirror of owner 1 serves verified bytes.
+                    h = s.get_batch_async("v", idx1)
+                    got = h.wait()
+                    assert (got == 2.0).all()
+                    # Give-up path: owner 2's whole readable chain
+                    # (itself + rank 1) serves corrupt bytes.
+                    h2 = s.get_batch_async("v", idx2)
+                    try:
+                        h2.wait()
+                        errs.append((rank, "corrupt batch delivered"))
+                    except DDStoreError:
+                        pass
+                finally:
+                    fault_configure("", 0)
+                assert s.async_pending() == 0, s.async_pending()
+                s.scrub_once()  # hash mirrors under the sanitizer
+                assert s.integrity_stats()["verify_failovers"] >= 1
+            intg_done.wait()
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=intg_worker, args=(r, errs))
+      for r in range(3)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
 print("stress ok")
 """
 

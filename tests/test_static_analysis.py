@@ -77,9 +77,13 @@ class TestWholeTree:
         assert "Ping" in tcp.excludes
         conn = m.classes["TcpTransport::Conn"]
         assert "mu" in conn.mutexes and conn.guarded["fd"] == "Conn::mu"
-        # declared order edge seeded into the graph
-        assert store.acquired_before["mu_"] == ["CmaRegistry::mu_"]
+        # declared order edges seeded into the graph (mu_ gained the
+        # integrity-table edge in ISSUE 11: Update/Rebind refresh sums
+        # under the exclusive registry lock)
+        assert store.acquired_before["mu_"] == ["CmaRegistry::mu_",
+                                                "sums_mu_"]
         assert store.acquired_before["async_mu_"] == ["WorkerPool::mu_"]
+        assert "sums_mu_" in store.no_blocking
         # the ISSUE 9 EnsureCmaPeer restructure moved the discovery
         # probe OUTSIDE cma_mu, so the old cma_mu -> Conn::mu order
         # edge no longer exists (and must not creep back: it was the
@@ -281,6 +285,107 @@ void SD::F() {
         fs = _lock_findings(_model(tmp_path, {"sd.cc": src}))
         cyc = [f for f in fs if f.category == "lock-order"]
         assert len(cyc) == 1 and "self-deadlock" in cyc[0].message
+
+
+class TestCallGraphPropagation:
+    """ISSUE 11 satellite: one-level call-graph propagation. A helper
+    that takes a lock propagates the acquisition edge to its direct
+    callers — purely lexical analysis sees no nesting in either
+    function and would miss the cycle entirely."""
+
+    SRC = """
+namespace dds {
+class Prop {
+ public:
+  void Helper() {
+    std::lock_guard<std::mutex> lock(b_);
+  }
+  void Caller() {
+    std::lock_guard<std::mutex> lock(a_);
+    Helper();
+  }
+ private:
+  std::mutex b_ DDS_ACQUIRED_BEFORE(a_);
+  std::mutex a_;
+};
+}
+"""
+
+    def test_helper_acquisition_propagates_to_caller(self, tmp_path):
+        m = _model(tmp_path, {"prop.cc": self.SRC})
+        _, edges = lockcheck.check_functions(m)
+        prop = [e for e in edges if "propagation" in e[2]]
+        assert prop == [("Prop::a_", "Prop::b_",
+                         f"prop.cc:{_line_of(self.SRC, 'Helper();')} "
+                         f"(Prop::Caller -> Helper, one-level "
+                         f"propagation)")]
+        cyc = [f for f in _lock_findings(m)
+               if f.category == "lock-order"]
+        assert len(cyc) == 1
+        assert "one-level propagation" in cyc[0].message
+        assert "Prop::a_->Prop::b_" in cyc[0].message
+
+    def test_consistent_order_through_helper_is_clean(self, tmp_path):
+        src = self.SRC.replace("DDS_ACQUIRED_BEFORE(a_)", "")
+        fs = _lock_findings(_model(tmp_path, {"prop.cc": src}))
+        assert [f for f in fs if f.category == "lock-order"] == []
+
+    def test_propagation_through_typed_receiver(self, tmp_path):
+        """The conservative resolution also covers a typed receiver
+        (`Other& o; o.Helper()`); an UNTYPED receiver is deliberately
+        skipped — a guessed edge is worse than a missed one."""
+        src = """
+namespace dds {
+class Other {
+ public:
+  void Helper() {
+    std::lock_guard<std::mutex> lock(om_);
+  }
+  std::mutex om_ DDS_ACQUIRED_BEFORE(User::um_);
+};
+class User {
+ public:
+  void Call(Other& o) {
+    std::lock_guard<std::mutex> lock(um_);
+    o.Helper();
+  }
+  std::mutex um_;
+};
+}
+"""
+        fs = _lock_findings(_model(tmp_path, {"recv.cc": src}))
+        cyc = [f for f in fs if f.category == "lock-order"]
+        assert len(cyc) == 1
+        assert "one-level propagation" in cyc[0].message
+
+    def test_lambda_acquisitions_not_propagated(self, tmp_path):
+        """A lock taken inside a lambda body runs LATER, on another
+        thread — it must not enter the helper's summary (the same
+        deferred-execution rule the lexical detectors use)."""
+        src = """
+namespace dds {
+class Lam {
+ public:
+  void Helper() {
+    auto task = [this]() {
+      std::lock_guard<std::mutex> lock(b_);
+    };
+    pool_.Submit(task);
+  }
+  void Caller() {
+    std::lock_guard<std::mutex> lock(a_);
+    Helper();
+  }
+ private:
+  std::mutex b_ DDS_ACQUIRED_BEFORE(a_);
+  std::mutex a_;
+  WorkerPool pool_;
+};
+}
+"""
+        m = _model(tmp_path, {"lam.cc": src})
+        _, edges = lockcheck.check_functions(m)
+        assert [e for e in edges if "propagation" in e[2]] == []
 
 
 class TestBlockingDetector:
