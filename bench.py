@@ -740,7 +740,8 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
 
     import numpy as np
 
-    from ddstore_tpu import DDStore, ThreadGroup, fault_configure
+    from ddstore_tpu import (DDStore, DDStoreError, ThreadGroup,
+                             fault_configure)
     from ddstore_tpu.data import DistributedSampler, ShardedDataset
     from ddstore_tpu.data.loader import DeviceLoader
 
@@ -750,7 +751,16 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
            # Chaos runs LANES-ENABLED (ISSUE 5 acceptance): injected
            # faults must be absorbed with the striped multi-lane
            # transport active, not just on the single-connection path.
-           "DDSTORE_TCP_LANES": "4", "DDSTORE_TCP_LANES_AUTOTUNE": "0"}
+           "DDSTORE_TCP_LANES": "4", "DDSTORE_TCP_LANES_AUTOTUNE": "0",
+           # Control-plane chaos block (ISSUE 12): ctrl-reset fires on
+           # a large fraction of control round trips; a deeper control
+           # retry budget keeps the per-op exhaustion probability
+           # negligible (reset-only 0.3^7 — the 800 ms ctrl-stall is
+           # LATENCY under this 1000 ms per-attempt deadline, not a
+           # failed attempt) so the block certifies absorption, not
+           # luck.
+           "DDSTORE_CONTROL_TIMEOUT_MS": "1000",
+           "DDSTORE_CONTROL_RETRY_MAX": "6"}
     backup = {k: os.environ.get(k) for k in env}
     os.environ.update(env)
     out = {}
@@ -808,6 +818,60 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
                         for k in ("injected_reset", "injected_trunc",
                                   "injected_delay", "injected_stall"))
                     fsum = l_ra.metrics.summary().get("faults", {})
+                    # Control-plane chaos block (ISSUE 12): the ctrl
+                    # injector arm hammers the request/response control
+                    # ops — snapshot pin placement + release, world-1
+                    # round trips each way — while the data plane stays
+                    # COLD (ctrl draws live in their own counter
+                    # domain; zero data draws proves the scope pin).
+                    # Every acquire must land despite ~55% of control
+                    # round trips being reset/delayed/stalled: the
+                    # bounded ControlRetry absorbs them with zero
+                    # retry-ladder giveups.
+                    fault_configure(
+                        "ctrl-reset:0.3,ctrl-delay:0.2:5,"
+                        "ctrl-stall:0.05:800", 77)
+                    fsc0 = s.fault_stats()
+                    ctrl_failures = 0
+                    try:
+                        for _ in range(12):
+                            # A failed acquire is a GATE failure, not a
+                            # phase crash: the native all-or-nothing
+                            # unwind already rolled its pins back, so
+                            # counting it keeps the block diagnosable
+                            # from the JSON alone.
+                            try:
+                                h = s.attach("ctrl-probe",
+                                             snapshot=True)
+                                h.detach()
+                            except DDStoreError:
+                                ctrl_failures += 1
+                        fsc = s.fault_stats()
+                    finally:
+                        fault_configure("", 0)
+                    # The data path is untouched and still correct.
+                    np.testing.assert_array_equal(
+                        s.get_batch("ds/data",
+                                    np.arange(batch, 2 * batch)),
+                        data[batch:2 * batch])
+                    ctrl_injected = (fsc["ctrl_injected"]
+                                     - fsc0["ctrl_injected"])
+                    out.update({
+                        "chaos_ctrl_checks": fsc["ctrl_checks"]
+                        - fsc0["ctrl_checks"],
+                        "chaos_ctrl_injected": ctrl_injected,
+                        "chaos_ctrl_data_draws": fsc["fault_checks"]
+                        - fsc0["fault_checks"],
+                        "chaos_ctrl_giveups": fsc["retry_giveups"]
+                        - fsc0["retry_giveups"],
+                        "chaos_ctrl_acquire_failures": ctrl_failures,
+                        "chaos_ctrl_ok": ctrl_injected > 0
+                        and ctrl_failures == 0
+                        and fsc["retry_giveups"]
+                        == fsc0["retry_giveups"]
+                        and fsc["fault_checks"]
+                        == fsc0["fault_checks"],
+                    })
                     out.update({
                         "chaos_injected": injected,
                         "chaos_retries": fs["retry_attempts"]
@@ -823,9 +887,11 @@ def chaos_bench(world=4, num=16384, dim=64, batch=256):
                             if t_pb + t_ra > 0 else 0.0,
                         # byte-identical asserted above; nonzero
                         # injections + zero give-ups = faults were both
-                        # PROVOKED and ABSORBED
+                        # PROVOKED and ABSORBED — on the data plane AND
+                        # (ISSUE 12) the control plane
                         "chaos_ok": injected > 0
-                        and fs["retry_giveups"] == fs0["retry_giveups"],
+                        and fs["retry_giveups"] == fs0["retry_giveups"]
+                        and out["chaos_ctrl_ok"],
                     })
                 s.barrier()
 
@@ -1406,12 +1472,15 @@ def tenants_bench(world=4, num=16384, dim=64, batch=256, epochs=8):
 
 
 _FAILOVER_WORKER = r"""
-import os, sys, threading, time
+import glob, json, os, sys, threading, time
 sys.path.insert(0, os.environ["DDSTORE_BENCH_REPO"])
 import numpy as np
-from ddstore_tpu import DDStore, DDStoreError, FileGroup
+from ddstore_tpu import (DDStore, DDStoreError, FileGroup,
+                         elastic_recover, elastic_rejoin)
+from ddstore_tpu.binding import ERR_PEER_LOST
 from ddstore_tpu.data import DistributedSampler, ShardedDataset
 from ddstore_tpu.data.loader import DeviceLoader
+from ddstore_tpu.utils import save_shard
 
 rank = int(os.environ["DDSTORE_RANK"])
 world = int(os.environ["DDSTORE_WORLD"])
@@ -1420,7 +1489,38 @@ rdv = os.environ["DDSTORE_RDV_DIR"]
 num = int(os.environ["DDSTORE_BENCH_NUM"])
 dim = int(os.environ["DDSTORE_BENCH_DIM"])
 batch = int(os.environ["DDSTORE_BENCH_BATCH"])
+rejoin_mode = os.environ.get("DDSTORE_REJOIN") == "1"
 rows = num // world
+eroot = os.path.join(rdv, "elastic")
+ckpt = os.path.join(rdv, "ckpt")
+done = os.path.join(rdv, "DONE")
+
+def wait_file(path, budget_s=60.0):
+    deadline = time.monotonic() + budget_s
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise RuntimeError("timed out waiting for " + path)
+        time.sleep(0.01)
+
+def resumed_fence(store):
+    # One COLLECTIVE epoch fence across the recovered world proves the
+    # control plane resumed end to end (the fence abort rolled state
+    # back; recovery realigned barrier seqs).
+    store._native.set_epoch_collective(True)
+    store.epoch_begin()
+    store.epoch_end()
+    store._native.set_epoch_collective(False)
+
+if rejoin_mode:
+    # The relaunched replacement: restore the shard from the
+    # checkpoint, join the recovery generation, prove the resumed
+    # fence, then serve until the driver finishes.
+    store = elastic_rejoin(eroot, rank, world, ckpt, timeout=120)
+    resumed_fence(store)
+    print("REJOINED", flush=True)
+    while not os.path.exists(done):
+        time.sleep(0.05)
+    os._exit(0)
 
 g = FileGroup(rdv, rank, world)
 store = DDStore(g, backend="tcp")
@@ -1431,27 +1531,24 @@ shard = np.random.default_rng(100 + rank).standard_normal(
 # Collective registration (add + replicate barriers inside).
 ds = ShardedDataset(store, shard, pre_sharded=True)
 store.barrier()
+# Checkpoint every variable so the replacement can rejoin (the elastic
+# contract: the recovered shard holds the LAST CHECKPOINT).
+for vname in store.variables():
+    save_shard(store, vname, ckpt)
+store.barrier()
 
-done = os.path.join(rdv, "DONE")
 if rank == victim:
     print("VICTIM_READY", flush=True)
-    while True:  # "train" until the harness SIGKILLs us
+    while True:  # "train" until the harness SIGKILLs us mid-fence
         time.sleep(0.02)
-if rank != 0:
-    # Survivor owners: serve shard + mirror until the driver finishes
-    # (no barriers after the kill — exit abruptly like a real teardown).
-    while not os.path.exists(done):
-        time.sleep(0.05)
-    os._exit(0)
 
-# Rank 0 drives: clean epoch -> mid-epoch SIGKILL -> failover epoch.
 oracle = np.concatenate([
     np.random.default_rng(100 + r).standard_normal(
         (rows, dim)).astype(np.float32) for r in range(world)])
 sampler = DistributedSampler(num, world=1, rank=0, seed=7)
 
 
-def epoch(pace_s=0.0, kill_after=None):
+def epoch(pace_s=0.0, kill_after=None, killme="KILLME"):
     loader = DeviceLoader(ds, sampler, batch_size=batch, mesh=None,
                           readahead_windows=2,
                           readahead_window_batches=4)
@@ -1459,25 +1556,76 @@ def epoch(pace_s=0.0, kill_after=None):
     for i, b in enumerate(loader):
         out.append(b.copy())
         if kill_after is not None and i == kill_after:
-            open(os.path.join(rdv, "KILLME"), "w").close()
+            open(os.path.join(rdv, killme), "w").close()
         if pace_s:
             time.sleep(pace_s)
     return out, loader
 
-ref, _ = epoch()
-it = iter(sampler)
-import itertools
-for b in ref:  # absolute correctness of the clean epoch
-    idx = np.fromiter(itertools.islice(it, batch), np.int64)
-    np.testing.assert_array_equal(b, oracle[idx])
+if rank == 0:
+    ref, _ = epoch()
+    it = iter(sampler)
+    import itertools
+    for b in ref:  # absolute correctness of the clean epoch
+        idx = np.fromiter(itertools.islice(it, batch), np.int64)
+        np.testing.assert_array_equal(b, oracle[idx])
+    # Arm the fence-abort act: every survivor enters a COLLECTIVE
+    # epoch fence; the driver SIGKILLs the victim while they wait.
+    open(os.path.join(rdv, "FENCE_GO"), "w").close()
+else:
+    wait_file(os.path.join(rdv, "FENCE_GO"), 180.0)
 
-# Suspect-latency poller: KILLED carries the parent's wall time at
+# -- Act: SIGKILL inside an epoch fence (ISSUE 12 acceptance) -----------
+# Survivors block in the fence barrier; the victim dies without ever
+# arriving. The detector-integrated barrier must classify ERR_PEER_LOST
+# (naming the victim) in O(heartbeat) — never the 30 s
+# DDSTORE_BARRIER_TIMEOUT_S this phase runs under.
+store._native.set_epoch_collective(True)
+fence_code = 0
+try:
+    store.epoch_begin()
+except DDStoreError as e:
+    fence_code = e.code
+abort_wall = time.time()
+store._native.set_epoch_collective(False)
+wait_file(os.path.join(rdv, "KILLED1"), 30.0)
+t_kill1 = float(open(os.path.join(rdv, "KILLED1")).read().strip())
+# Clamp at 0: the abort can land between the SIGKILL and the driver's
+# timestamp write (the detector is that fast).
+with open(os.path.join(rdv, "fence_r%d.json" % rank), "w") as f:
+    json.dump({"code": fence_code,
+               "abort_s": round(max(0.0, abort_wall - t_kill1), 3)}, f)
+
+# -- Act: elastic recovery + resumed collective fence -------------------
+elastic_recover(store, eroot, timeout=120)
+resumed_fence(store)
+
+if rank != 0:
+    # Survivor owners: serve shard + mirror until the driver finishes
+    # (no barriers after the second kill — exit abruptly like a real
+    # teardown).
+    while not os.path.exists(done):
+        time.sleep(0.05)
+    os._exit(0)
+
+# Rank 0: the RESUMED epoch must be byte-identical to the per-rank
+# seeded oracle (the replacement restored the victim's shard from its
+# checkpoint; nothing was updated, so clean-epoch bytes are the truth).
+resumed, _ = epoch()
+fence_resumed_identical = len(resumed) == len(ref) and all(
+    np.array_equal(a, b) for a, b in zip(ref, resumed))
+fence_results = []
+for p in sorted(glob.glob(os.path.join(rdv, "fence_r*.json"))):
+    with open(p) as f:
+        fence_results.append(json.load(f))
+
+# -- Act: mid-epoch SIGKILL of the (recovered) owner --------------------
+# Suspect-latency poller: KILLED2 carries the parent's wall time at
 # SIGKILL; latency = first suspected observation - that.
 latency = {}
 
 
 def poll():
-    killed = os.path.join(rdv, "KILLED")
+    killed = os.path.join(rdv, "KILLED2")
     while not os.path.exists(killed):
         time.sleep(0.01)
     t_kill = float(open(killed).read().strip())
@@ -1492,7 +1640,7 @@ fs0 = store.fault_stats()
 peer_lost = 0
 t0 = time.perf_counter()
 try:
-    chaos, loader = epoch(pace_s=0.03, kill_after=2)
+    chaos, loader = epoch(pace_s=0.03, kill_after=2, killme="KILLME2")
 except DDStoreError as e:
     peer_lost = 1
     chaos, loader = [], None
@@ -1524,7 +1672,25 @@ trace_ok = bool(
     and f"suspect (peer={victim}" in tree         # verdict named
     and f"dead_owner={victim}" in tree            # reroutes named
     and n_failover_evts >= max(1, reroutes))      # every rerouted op
+hb_budget_s = (int(os.environ["DDSTORE_HEARTBEAT_MS"])
+               * int(os.environ["DDSTORE_HEARTBEAT_SUSPECT_N"])) / 1e3
+barrier_timeout_s = float(os.environ["DDSTORE_BARRIER_TIMEOUT_S"])
+fence_bound_s = min(max(5.0, 10 * hb_budget_s), barrier_timeout_s)
 result = {
+    # Fence-abort act: every survivor classified the mid-fence SIGKILL
+    # as ERR_PEER_LOST within the detector bound (never the barrier
+    # timeout), recovery + the resumed collective fence completed, and
+    # the resumed epoch is byte-identical to the seeded oracle.
+    "fence_abort_codes": [r["code"] for r in fence_results],
+    "fence_abort_max_s": max((r["abort_s"] for r in fence_results),
+                             default=-1.0),
+    "fence_resumed_identical": bool(fence_resumed_identical),
+    "fence_abort_ok": bool(
+        len(fence_results) == world - 1
+        and all(r["code"] == ERR_PEER_LOST for r in fence_results)
+        and all(0 <= r["abort_s"] <= fence_bound_s
+                for r in fence_results)
+        and fence_resumed_identical),
     "failover_epoch_identical": bool(identical),
     "failover_peer_lost_raised": peer_lost,
     "failover_flight_dumps_auto": int(auto_flights),
@@ -1539,8 +1705,6 @@ result = {
     "failover_epoch_s": round(t_chaos, 3),
     "failover_summary_present": "failover" in summary,
 }
-hb_budget_s = (int(os.environ["DDSTORE_HEARTBEAT_MS"])
-               * int(os.environ["DDSTORE_HEARTBEAT_SUSPECT_N"])) / 1e3
 result["failover_ok"] = bool(
     identical and peer_lost == 0
     and result["failover_giveups"] == 0
@@ -1549,8 +1713,9 @@ result["failover_ok"] = bool(
     # Detection must beat the data path's ladder by construction: the
     # heartbeat budget (x10 CPU-noise margin, the house timing style)
     # is far under one DDSTORE_OP_DEADLINE_S.
-    and 0 <= detect_s <= max(5.0, 10 * hb_budget_s))
-import json
+    and 0 <= detect_s <= max(5.0, 10 * hb_budget_s)
+    # ISSUE 12: the mid-fence kill act gates the phase too.
+    and result["fence_abort_ok"])
 print("#FAILOVER# " + json.dumps(result), flush=True)
 open(done, "w").close()
 os._exit(0)
@@ -1599,27 +1764,47 @@ def failover_bench(world=4, num=8192, dim=32, batch=64, victim=2):
     )
     logs = [os.path.join(tmp, f"r{r}.log") for r in range(world)]
     procs = {}
+
+    def wait_marker(path, budget_s, what):
+        deadline = time.monotonic() + budget_s
+        while not os.path.exists(path):
+            if procs[0].poll() is not None or \
+                    time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"failover driver never reached {what}: " +
+                    open(logs[0], "rb").read().decode(
+                        errors="replace")[-2000:])
+            time.sleep(0.05)
+
     try:
         for r in range(world):
             procs[r] = subprocess.Popen(
                 [sys.executable, "-c", _FAILOVER_WORKER],
                 env=dict(env, DDSTORE_RANK=str(r)),
                 stdout=open(logs[r], "ab"), stderr=subprocess.STDOUT)
-        killme = os.path.join(tmp, "KILLME")
-        deadline = time.monotonic() + 180
-        while not os.path.exists(killme):
-            if procs[0].poll() is not None or \
-                    time.monotonic() > deadline:
-                raise RuntimeError(
-                    "failover driver never reached the kill point: " +
-                    open(logs[0], "rb").read().decode(
-                        errors="replace")[-2000:])
-            time.sleep(0.05)
+        # Act 1: rank 0 finishes its clean epoch and arms the fence;
+        # survivors enter the collective epoch fence.
+        wait_marker(os.path.join(tmp, "FENCE_GO"), 180, "the fence")
+        time.sleep(0.5)  # let every survivor block inside the fence
         procs[victim].send_signal(signal.SIGKILL)
         procs[victim].wait()
-        # The wall timestamp of the ACTUAL kill, for the
-        # detection-latency export (same clock base, same host).
-        with open(os.path.join(tmp, "KILLED"), "w") as f:
+        with open(os.path.join(tmp, "KILLED1"), "w") as f:
+            f.write(str(time.time()))
+        # Act 2: relaunch the victim rank as an elastic replacement —
+        # survivors are entering elastic_recover after their fence
+        # aborts; the replacement rejoins from the checkpoints.
+        procs[victim] = subprocess.Popen(
+            [sys.executable, "-c", _FAILOVER_WORKER],
+            env=dict(env, DDSTORE_RANK=str(victim),
+                     DDSTORE_REJOIN="1"),
+            stdout=open(logs[victim], "ab"), stderr=subprocess.STDOUT)
+        # Act 3: rank 0 verifies the resumed epoch, then runs the
+        # mid-epoch failover epoch — SIGKILL the RECOVERED owner.
+        wait_marker(os.path.join(tmp, "KILLME2"), 240,
+                    "the mid-epoch kill point")
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait()
+        with open(os.path.join(tmp, "KILLED2"), "w") as f:
             f.write(str(time.time()))
         assert procs[0].wait(timeout=180) == 0, \
             open(logs[0], "rb").read().decode(errors="replace")[-2000:]
@@ -2902,7 +3087,10 @@ def _phase_chaos():
           f"({o.get('chaos_reconnects', 0)} reconnects, "
           f"{o.get('chaos_windows_retried', 0)} window retries), "
           f"{o.get('chaos_giveups', 0)} give-ups, byte-identical epochs, "
-          f"{o.get('chaos_epoch_overhead_x', 0):.2f}x wall overhead -> "
+          f"{o.get('chaos_epoch_overhead_x', 0):.2f}x wall overhead; "
+          f"ctrl arm: {o.get('chaos_ctrl_injected', 0)} control faults "
+          f"absorbed ({o.get('chaos_ctrl_giveups', 0)} give-ups, "
+          f"{o.get('chaos_ctrl_data_draws', 0)} data-plane draws) -> "
           f"{'OK' if o.get('chaos_ok') else 'NOT OK'}", file=sys.stderr)
     return o
 
@@ -2959,7 +3147,13 @@ def _phase_trace():
 
 def _phase_failover():
     o = failover_bench()
-    print(f"# failover (R=2, owner SIGKILLed mid-epoch): epoch "
+    print(f"# failover (R=2): owner SIGKILLed INSIDE an epoch fence -> "
+          f"survivors classified {o.get('fence_abort_codes', [])} in "
+          f"<= {o.get('fence_abort_max_s', -1):.2f}s, recovered, "
+          f"resumed epoch "
+          f"{'byte-identical' if o.get('fence_resumed_identical') else 'DIVERGED'} "
+          f"(fence {'OK' if o.get('fence_abort_ok') else 'NOT OK'}); "
+          f"recovered owner SIGKILLed mid-epoch -> epoch "
           f"{'byte-identical' if o.get('failover_epoch_identical') else 'DIVERGED'}, "
           f"{o.get('failover_reads', 0)} reads served from replicas "
           f"({o.get('failover_suspect_skips', 0)} detector "

@@ -90,7 +90,7 @@ def _load() -> ctypes.CDLL:
     lib.dds_query.restype = ctypes.c_int
     lib.dds_query.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _i64p, _i64p,
                               _i64p, _i64p]
-    for fn in ("dds_epoch_begin", "dds_epoch_end"):
+    for fn in ("dds_epoch_begin", "dds_epoch_end", "dds_fence_reset"):
         getattr(lib, fn).restype = ctypes.c_int
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
     lib.dds_set_epoch_collective.restype = ctypes.c_int
@@ -272,11 +272,16 @@ def fault_configure(spec: str, seed: int = 0,
     the runtime equivalent of ``DDSTORE_FAULT_SPEC``/``_SEED``/``_RANKS``.
 
     ``spec`` is ``kind:probability[:param_ms]`` entries joined by commas
-    (kinds: ``reset``, ``trunc``, ``delay``, ``stall``); an empty spec
-    disables injection. ``ranks`` restricts injection to ops SERVED by
-    those ranks (per-peer fault schedules in shared-process tests).
-    Resets every injector counter including the draw counter, so the
-    same ``(spec, seed)`` replays the same fault schedule."""
+    (data kinds: ``reset``, ``trunc``, ``delay``, ``stall``,
+    ``corrupt``; control-plane kinds: ``ctrl-reset``, ``ctrl-delay``,
+    ``ctrl-stall`` — these target the request/response control ops and
+    draw from their OWN seeded counter domain, so data-plane schedules
+    are bit-identical with the ctrl arm present or absent); an empty
+    spec disables injection. ``ranks`` restricts injection to ops
+    SERVED by those ranks (per-peer fault schedules in shared-process
+    tests). Resets every injector counter including both draw
+    counters, so the same ``(spec, seed)`` replays the same fault
+    schedule."""
     ranks_csv = ",".join(str(int(r)) for r in ranks) if ranks else ""
     _check(_load().dds_fault_configure(spec.encode(), int(seed),
                                        ranks_csv.encode()),
@@ -311,7 +316,8 @@ TRACE_TYPES = {
     12: "window_stall", 13: "plan_replan", 14: "plan_applied",
     15: "suspect", 16: "suspect_clear", 17: "quota_reject",
     18: "lane_budget_rotate", 19: "flight", 20: "failover",
-    21: "verify_fail", 22: "scrub",
+    21: "verify_fail", 22: "scrub", 23: "barrier", 24: "barrier_done",
+    25: "barrier_abort",
 }
 #: name -> code view of :data:`TRACE_TYPES` (Python-side emitters).
 TRACE_TYPE_CODES = {v: k for k, v in TRACE_TYPES.items()}
@@ -322,7 +328,8 @@ TRACE_OP_CLASSES = {0: "get", 1: "get_batch", 2: "read_runs",
 
 #: flight-recorder trigger codes (trace.h FlightReason).
 TRACE_FLIGHT_REASONS = {1: "peer_lost", 2: "quota", 3: "window_giveup",
-                        4: "suspect", 5: "manual", 6: "corrupt"}
+                        4: "suspect", 5: "manual", 6: "corrupt",
+                        7: "barrier_abort"}
 
 #: dict keys of :func:`trace_stats`, in native layout order (keep in
 #: sync with capi dds_trace_stats / trace::Stats).
@@ -470,7 +477,7 @@ FAULT_STAT_KEYS = (
     "injected_stall", "injected_delay_ms",
     "retry_transient", "retry_attempts", "retry_reconnects",
     "retry_backoff_ms", "retry_giveups", "retry_fatal", "last_error_peer",
-    "injected_corrupt",
+    "injected_corrupt", "ctrl_checks", "ctrl_injected",
 )
 
 
@@ -943,6 +950,16 @@ class NativeStore:
 
     def set_epoch_collective(self, collective: bool) -> None:
         _check(self._lib.dds_set_epoch_collective(self._h, int(collective)))
+
+    def fence_reset(self) -> None:
+        """Force the epoch-fence state machine closed (local,
+        idempotent) — the elastic-recovery realignment hook: a fence
+        abort need not be unanimous (a victim that partially
+        disseminated its barrier notifies can let some survivors
+        complete the fence while others roll back), so ``recover()``
+        resets every rank to one agreed pre-fence state before the
+        group re-enters its first post-recovery epoch."""
+        _check(self._lib.dds_fence_reset(self._h), "fence_reset")
 
     def rebind(self, name: str, arr: np.ndarray) -> None:
         """Atomically swap the local shard's backing memory to ``arr``

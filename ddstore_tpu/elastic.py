@@ -49,11 +49,13 @@ _GEN_FILE = "GENERATION"
 
 
 def _default_timeout() -> float:
-    """The rendezvous must outlast the slowest death-detection path: a
-    survivor wedged in a data-plane barrier with the dead rank notices
-    only after DDSTORE_BARRIER_TIMEOUT_S (default 300 s). Every survivor
-    must reach recover() before the first one's rendezvous expires, so
-    the default waits that long plus margin."""
+    """The rendezvous must outlast the slowest death-detection path.
+    With the heartbeat detector ON, a survivor wedged in a barrier or
+    epoch fence aborts in O(heartbeat) (the detector-integrated
+    barrier); the worst case is the detector-OFF one — a survivor
+    notices only after DDSTORE_BARRIER_TIMEOUT_S (default 300 s).
+    Every survivor must reach recover() before the first one's
+    rendezvous expires, so the default waits that long plus margin."""
     try:
         barrier_s = float(os.environ.get("DDSTORE_BARRIER_TIMEOUT_S", 300))
     except ValueError:
@@ -241,10 +243,30 @@ def recover(store: DDStore, root: str,
     store.group = group
     store._generation = gen
     _commit_generation(root, gen)
+    # Fence realignment: a fence abort need not have been unanimous (a
+    # victim that partially disseminated its notifies can let some
+    # survivors complete the fence others aborted), so every survivor
+    # forces its fence state machine closed here — the group re-enters
+    # its first post-recovery epoch from one agreed state. Idempotent
+    # and local; the replacement's fresh store starts closed anyway.
+    store.fence_reset()
     # Data-plane barrier proves end-to-end connectivity of the new world
-    # before anyone resumes training.
-    store.barrier()
-    _restore_replication(store)
+    # before anyone resumes training. RE-ENTERABLE: this (and the
+    # replication rebuild) can itself abort if ANOTHER rank dies
+    # mid-recovery — the failure-aware barrier classifies that in
+    # O(heartbeat) — and by this point the generation is committed, so
+    # the survivors simply run another recover() round (targeting
+    # generation gen+1) for the newly dead rank.
+    try:
+        store.barrier()
+        _restore_replication(store)
+    except DDStoreError as e:
+        raise DDStoreError(
+            e.code,
+            f"elastic recovery generation {gen}: a peer died during "
+            f"the post-recovery collective ({e}); the generation is "
+            f"committed — call recover() again to run the next "
+            f"recovery round for the newly dead rank") from None
 
 
 def rejoin(root: str, rank: int, world: int, ckpt_dir: str, *,

@@ -456,6 +456,15 @@ int dds_set_epoch_collective(dds_handle* h, int collective) {
   return dds::kOk;
 }
 
+// Elastic-recovery fence realignment: force the fence state machine
+// closed (local, idempotent) — a non-unanimous fence abort can leave
+// fence_active_ divergent across survivors; recover() heals it here.
+int dds_fence_reset(dds_handle* h) {
+  if (!h) return dds::kErrInvalidArg;
+  h->store->FenceReset();
+  return dds::kOk;
+}
+
 int dds_set_ifaces(dds_handle* h, const char* csv) {
   if (!h || !h->tcp || !csv) return dds::kErrInvalidArg;
   h->tcp->SetLocalIfaces(dds::SplitCsv(csv));
@@ -519,7 +528,9 @@ int dds_fault_configure(const char* spec, uint64_t seed,
 //   [12]    last_error_peer (most recent failed target; -1 = none —
 //           the TCP layer's wins when both are set)
 //   [13]    injected_corrupt (payloads served with flipped bytes)
-//   [14..15] reserved (0)
+//   [14]    ctrl_checks (control-plane injector draws — own counter
+//           domain; see fault.h)
+//   [15]    ctrl_injected (control-plane faults fired)
 int dds_fault_stats(dds_handle* h, int64_t out[16]) {
   if (!h || !out) return dds::kErrInvalidArg;
   for (int i = 0; i < 16; ++i) out[i] = 0;
@@ -531,6 +542,8 @@ int dds_fault_stats(dds_handle* h, int64_t out[16]) {
   out[4] = fi.stall;
   out[5] = fi.delay_ms;
   out[13] = fi.corrupt;
+  out[14] = fi.ctrl_checks;
+  out[15] = fi.ctrl_injected;
   int64_t st[7], tc[7] = {0, 0, 0, 0, 0, 0, -1};
   h->store->RetryCounters(st);
   if (h->tcp) h->tcp->RetryCounters(tc);

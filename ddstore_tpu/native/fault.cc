@@ -23,6 +23,10 @@ uint64_t Mix64(uint64_t x) {
   return x ^ (x >> 31);
 }
 
+// Salt folded into the control domain's hash so the two domains'
+// schedules decorrelate even at equal counter values.
+constexpr uint64_t kCtrlDomainSalt = 0xC7B1A9E5D3F08642ULL;
+
 bool ParseKind(const std::string& tok, FaultKind* kind, int* dflt_ms) {
   if (tok == "reset") {
     *kind = FaultKind::kReset;
@@ -65,7 +69,11 @@ FaultInjector::FaultInjector() {
 int FaultInjector::Configure(const std::string& spec, uint64_t seed,
                              const std::string& ranks_csv) {
   std::vector<Rule> rules;
-  double cum_p = 0.0;
+  std::vector<Rule> ctrl_rules;
+  // Independent cumulative-probability spaces: a spec may dedicate up
+  // to probability 1.0 to EACH domain (the control plane sees far
+  // fewer ops, so chaos runs arm it at much higher rates).
+  double cum_p = 0.0, ctrl_cum_p = 0.0;
   size_t pos = 0;
   while (pos < spec.size()) {
     size_t end = spec.find(',', pos);
@@ -73,12 +81,22 @@ int FaultInjector::Configure(const std::string& spec, uint64_t seed,
     std::string entry = spec.substr(pos, end - pos);
     pos = end + 1;
     if (entry.empty()) continue;
-    // kind:probability[:param_ms]
+    // [ctrl-]kind:probability[:param_ms]
     size_t c1 = entry.find(':');
     if (c1 == std::string::npos) return kErrInvalidArg;
+    std::string kind_tok = entry.substr(0, c1);
+    bool ctrl = false;
+    if (kind_tok.compare(0, 5, "ctrl-") == 0) {
+      ctrl = true;
+      kind_tok = kind_tok.substr(5);
+    }
     FaultKind kind;
     int param_ms;
-    if (!ParseKind(entry.substr(0, c1), &kind, &param_ms))
+    if (!ParseKind(kind_tok, &kind, &param_ms)) return kErrInvalidArg;
+    // The control plane has no payload to truncate or corrupt: its
+    // failure modes are a dropped connection and latency.
+    if (ctrl &&
+        (kind == FaultKind::kTrunc || kind == FaultKind::kCorrupt))
       return kErrInvalidArg;
     size_t c2 = entry.find(':', c1 + 1);
     char* endp = nullptr;
@@ -92,14 +110,15 @@ int FaultInjector::Configure(const std::string& spec, uint64_t seed,
       if (!endp || *endp || ms < 0) return kErrInvalidArg;
       param_ms = static_cast<int>(ms);
     }
-    cum_p += p;
-    if (cum_p > 1.0 + 1e-9) return kErrInvalidArg;
+    double& cp = ctrl ? ctrl_cum_p : cum_p;
+    cp += p;
+    if (cp > 1.0 + 1e-9) return kErrInvalidArg;
     // Threshold in 2^64 space; clamp the running sum to the top.
-    double scaled = cum_p * 1.8446744073709552e19;  // 2^64
+    double scaled = cp * 1.8446744073709552e19;  // 2^64
     uint64_t cum = scaled >= 1.8446744073709552e19
                        ? ~0ULL
                        : static_cast<uint64_t>(scaled);
-    rules.push_back(Rule{kind, cum, param_ms});
+    (ctrl ? ctrl_rules : rules).push_back(Rule{kind, cum, param_ms});
   }
   std::vector<int> ranks;
   size_t rp = 0;
@@ -115,9 +134,11 @@ int FaultInjector::Configure(const std::string& spec, uint64_t seed,
   {
     std::lock_guard<std::mutex> lock(mu_);
     rules_ = std::move(rules);
+    ctrl_rules_ = std::move(ctrl_rules);
     ranks_ = std::move(ranks);
     seed_ = seed;
     n_.store(0);
+    ctrl_n_.store(0);
     c_checks_.store(0);
     c_reset_.store(0);
     c_trunc_.store(0);
@@ -125,7 +146,10 @@ int FaultInjector::Configure(const std::string& spec, uint64_t seed,
     c_stall_.store(0);
     c_delay_ms_.store(0);
     c_corrupt_.store(0);
-    enabled_.store(!rules_.empty(), std::memory_order_release);
+    c_ctrl_checks_.store(0);
+    c_ctrl_injected_.store(0);
+    enabled_.store(!rules_.empty() || !ctrl_rules_.empty(),
+                   std::memory_order_release);
   }
   return kOk;
 }
@@ -175,6 +199,32 @@ FaultDecision FaultInjector::Draw(int rank) {
   return {};
 }
 
+FaultDecision FaultInjector::DrawCtrl(int rank) {
+  if (!enabled()) return {};
+  std::lock_guard<std::mutex> lock(mu_);
+  // No ctrl-* arm configured: zero cost, zero draws — the data-only
+  // schedules of PR 4/7/10 are untouched by construction.
+  if (ctrl_rules_.empty()) return {};
+  if (!ranks_.empty()) {
+    bool match = false;
+    for (int r : ranks_) match = match || r == rank;
+    if (!match) return {};
+  }
+  const uint64_t n = ctrl_n_.fetch_add(1, std::memory_order_relaxed);
+  c_ctrl_checks_.fetch_add(1, std::memory_order_relaxed);
+  const uint64_t h = Mix64(seed_ ^ kCtrlDomainSalt ^ Mix64(n));
+  for (const Rule& r : ctrl_rules_) {
+    if (h < r.cum) {
+      // ctrl_injected is the ONLY counter this domain touches: the
+      // data-plane stats (delay_ms included) stay bit-identical with
+      // the ctrl arm present or absent — the determinism pin.
+      c_ctrl_injected_.fetch_add(1, std::memory_order_relaxed);
+      return FaultDecision{r.kind, r.param_ms, Mix64(h)};
+    }
+  }
+  return {};
+}
+
 FaultInjector::Stats FaultInjector::stats() const {
   Stats s;
   s.checks = c_checks_.load();
@@ -184,7 +234,34 @@ FaultInjector::Stats FaultInjector::stats() const {
   s.stall = c_stall_.load();
   s.delay_ms = c_delay_ms_.load();
   s.corrupt = c_corrupt_.load();
+  s.ctrl_checks = c_ctrl_checks_.load();
+  s.ctrl_injected = c_ctrl_injected_.load();
   return s;
+}
+
+long ControlTimeoutMsFromEnv() {
+  long ms = 1000;
+  if (const char* env = std::getenv("DDSTORE_CONTROL_TIMEOUT_MS")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) ms = v;
+  }
+  return ms;
+}
+
+int ControlRetryMaxFromEnv() {
+  int n = 2;
+  if (const char* env = std::getenv("DDSTORE_CONTROL_RETRY_MAX")) {
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end != env && v >= 0) n = static_cast<int>(v);
+  }
+  return n;
+}
+
+long ControlBackoffMs(int attempt) {
+  long ms = 25L << (attempt < 4 ? attempt : 4);
+  return ms > 200 ? 200 : ms;
 }
 
 RetryPolicy RetryPolicy::FromEnv() {

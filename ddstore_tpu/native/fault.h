@@ -20,6 +20,19 @@
 // sequence produce byte-identical fault schedules AND counters — the
 // property the retry-metrics regression test pins. Compiled in always;
 // zero-cost when no spec is set (one relaxed atomic load per op).
+//
+// CONTROL-PLANE arm (ISSUE 12): "ctrl-reset:p,ctrl-delay:p:ms,
+// ctrl-stall:p:ms" entries target the request/response CONTROL ops
+// (kOpVarSeq / kOpRowSums / kOpSnapPin / kOpSnapUnpin and their local-
+// transport analogues) — the fences, snapshot-pin placement, and mirror
+// refresh probes that the data-only arms could never touch. Heartbeat
+// Ping frames and one-way barrier notifies stay clean: the detector's
+// verdict schedule must not depend on chaos config, and a dropped
+// one-way notify has no retry story (the barrier's failure mode is the
+// detector abort, not a lost frame). Ctrl decisions draw from their OWN
+// seeded counter domain (separate counter, salted hash), so every
+// existing data-plane draw schedule is bit-identical with the ctrl arm
+// present or absent — the PR 7/10 determinism pins hold by construction.
 
 #ifndef DDSTORE_TPU_FAULT_H_
 #define DDSTORE_TPU_FAULT_H_
@@ -97,6 +110,13 @@ class FaultInjector {
   // deterministic regardless of what other ranks serve).
   FaultDecision Draw(int rank);
 
+  // One decision for a CONTROL op served by `rank` (ctrl-* spec arms).
+  // Separate counter domain: ctrl draws never advance the data-plane
+  // counter and vice versa, so arming the ctrl arm leaves every data
+  // draw schedule bit-identical. Zero-cost ({} without consuming a
+  // draw) when no ctrl-* arm is configured.
+  FaultDecision DrawCtrl(int rank);
+
   struct Stats {
     int64_t checks = 0;    // draws consumed
     int64_t reset = 0;
@@ -105,6 +125,8 @@ class FaultInjector {
     int64_t stall = 0;
     int64_t delay_ms = 0;  // total injected sleep (delay + stall)
     int64_t corrupt = 0;   // payloads served with flipped bytes
+    int64_t ctrl_checks = 0;    // ctrl-domain draws consumed
+    int64_t ctrl_injected = 0;  // ctrl faults fired (reset+delay+stall)
   };
   Stats stats() const;
 
@@ -119,12 +141,18 @@ class FaultInjector {
 
   mutable std::mutex mu_;  // guards rules_/ranks_/seed_ (reconfiguration)
   std::vector<Rule> rules_ DDS_GUARDED_BY(mu_);
+  // Control-plane rules: their OWN cumulative-probability space and
+  // their OWN counter (ctrl_n_) so the two domains' schedules are
+  // independent pure functions of the seed.
+  std::vector<Rule> ctrl_rules_ DDS_GUARDED_BY(mu_);
   std::vector<int> ranks_ DDS_GUARDED_BY(mu_);  // empty = all ranks
   uint64_t seed_ DDS_GUARDED_BY(mu_) = 0;
   std::atomic<bool> enabled_{false};
-  std::atomic<uint64_t> n_{0};  // draw counter
+  std::atomic<uint64_t> n_{0};       // data-plane draw counter
+  std::atomic<uint64_t> ctrl_n_{0};  // control-plane draw counter
   std::atomic<int64_t> c_checks_{0}, c_reset_{0}, c_trunc_{0}, c_delay_{0},
       c_stall_{0}, c_delay_ms_{0}, c_corrupt_{0};
+  std::atomic<int64_t> c_ctrl_checks_{0}, c_ctrl_injected_{0};
 };
 
 // -- transient-retry policy --------------------------------------------------
@@ -186,6 +214,19 @@ struct RetryStats {
     out[6] = last_peer.load();
   }
 };
+
+// Control-plane round-trip knobs (shared by the TCP and in-process
+// transports): per-attempt deadline and bounded retry budget for the
+// request/response control ops (var-seq probes, row-sum fetches,
+// snapshot pin placement). These replace the old hardcoded one-shot
+// 1000 ms (kOpVarSeq) / 5000 ms (kOpRowSums) timeouts.
+long ControlTimeoutMsFromEnv();  // DDSTORE_CONTROL_TIMEOUT_MS (default 1000)
+int ControlRetryMaxFromEnv();    // DDSTORE_CONTROL_RETRY_MAX (default 2)
+
+// Backoff before control retry `attempt` (0-based): 25 << attempt ms,
+// capped at 200 — control ops are tiny and their budgets are per-op
+// deadlines, not the data path's exponential OP_DEADLINE ladder.
+long ControlBackoffMs(int attempt);
 
 // Interruptible sleep for injected delays/stalls and retry backoff:
 // sleeps in <=50 ms slices so teardown (`stop`) never waits out a long

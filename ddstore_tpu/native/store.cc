@@ -1779,10 +1779,22 @@ int64_t Store::SnapshotAcquire(const std::string& tenant) {
     rc = transport_->SnapshotControl(t, id, /*pin=*/true, tenant);
     if (rc != kOk) {
       // All-or-nothing: a snapshot that silently missed an owner would
-      // serve torn epochs. Roll back what was placed.
+      // serve torn epochs. Roll back what was placed (the partial-pin
+      // unwind). A mid-placement death feeds the suspect registry so
+      // the unpins below — and every later control op — short-circuit
+      // the corpse instead of re-burning its control budget. A LIVE
+      // peer whose unpin transiently fails (control chaos) gets one
+      // more pass: a stranded pin would hold copy-on-publish RAM for
+      // a snapshot nobody owns until that peer's store closes.
+      if (rc == kErrPeerLost) MarkPeerSuspected(t);
+      std::vector<int> failed;
       for (int u = 0; u < t; ++u)
-        if (u != rank())
-          transport_->SnapshotControl(u, id, /*pin=*/false, tenant);
+        if (u != rank() &&
+            transport_->SnapshotControl(u, id, /*pin=*/false,
+                                        tenant) != kOk)
+          failed.push_back(u);
+      for (int u : failed)
+        transport_->SnapshotControl(u, id, /*pin=*/false, tenant);
       UnpinSnapshot(id);
       return rc;
     }
@@ -2268,6 +2280,19 @@ int Store::Query(const std::string& name, int64_t* total_rows, int64_t* disp,
   return kOk;
 }
 
+void Store::NoteCollectiveFailure(int rc) {
+  if (rc != kErrPeerLost) return;
+  const int lost = transport_->last_failed_peer();
+  if (lost < 0 || lost >= world() || lost == rank()) return;
+  // Feed the shared suspect registry (idempotent when the verdict came
+  // FROM the detector) and the store-level naming channel —
+  // dds_fault_stats' last_error_peer prefers the TCP layer's counter,
+  // which the TCP barrier abort set itself; this covers the local
+  // backend's counting barrier.
+  MarkPeerSuspected(lost);
+  retry_.last_peer.store(lost);
+}
+
 int Store::EpochBegin() {
   int64_t tag;
   {
@@ -2279,6 +2304,23 @@ int Store::EpochBegin() {
   int rc = kOk;
   if (epoch_collective_ && world() > 1)
     rc = transport_->Barrier((tag << 1) | 0);
+  if (rc != kOk) {
+    // Crash-consistent fence: an aborted begin-barrier must leave
+    // RECOVERABLE state, not half-state. Roll the state machine back
+    // (fence closed, tag un-consumed) — every survivor aborts the same
+    // fence, so the rolled-back tags stay aligned across the group and
+    // elastic.recover + a re-entered epoch_begin work, instead of
+    // every later fence dying on kErrEpochState. The mirror refresh
+    // below is skipped too: mirrors keep their last-good pre-fence
+    // bytes, exactly the copy failover serves while the owner is down.
+    {
+      std::unique_lock<std::shared_mutex> lock(mu_);
+      fence_active_ = false;
+      --epoch_tag_;
+    }
+    NoteCollectiveFailure(rc);
+    return rc;
+  }
   // Mirror refresh rides the epoch fence: Update()s applied since the
   // last fence become failover-visible here (the paper's
   // update/epoch_begin contract). Content-version-gated — a static
@@ -2286,8 +2328,8 @@ int Store::EpochBegin() {
   // whole-shard pull. Suspected owners are skipped — their mirror
   // keeps the last good bytes — and refresh failures are counted,
   // never fatal (a dying owner must not fail the fence).
-  if (rc == kOk && replication_ > 1) RefreshMirrors(/*force=*/false);
-  return rc;
+  if (replication_ > 1) RefreshMirrors(/*force=*/false);
+  return kOk;
 }
 
 int Store::EpochEnd() {
@@ -2298,9 +2340,23 @@ int Store::EpochEnd() {
     fence_active_ = false;
     tag = epoch_tag_;
   }
-  if (epoch_collective_ && world() > 1)
-    return transport_->Barrier((tag << 1) | 1);
+  if (epoch_collective_ && world() > 1) {
+    const int rc = transport_->Barrier((tag << 1) | 1);
+    // The fence stays CLOSED on an aborted end-barrier (re-opening it
+    // would demand a second epoch_end nobody will issue): the next
+    // epoch_begin re-enters cleanly after recovery.
+    NoteCollectiveFailure(rc);
+    return rc;
+  }
   return kOk;
+}
+
+void Store::FenceReset() {
+  std::unique_lock<std::shared_mutex> lock(mu_);
+  fence_active_ = false;
+  // epoch_tag_ is deliberately left alone: barrier matching is by the
+  // transport's collective seq (realigned by recover via
+  // set_barrier_seq), and the tag only labels fences for diagnostics.
 }
 
 int Store::Rebind(const std::string& name, void* base) {
@@ -2421,7 +2477,9 @@ int Store::FreeAll() {
 
 int Store::Barrier(int64_t tag) {
   if (world() <= 1) return kOk;
-  return transport_->Barrier(tag);
+  const int rc = transport_->Barrier(tag);
+  NoteCollectiveFailure(rc);
+  return rc;
 }
 
 char* Store::LocalBase(const std::string& name) const {

@@ -685,6 +685,15 @@ class Store {
   int EpochBegin();
   int EpochEnd();
   void set_epoch_collective(bool collective) { epoch_collective_ = collective; }
+  // Elastic-recovery fence realignment: force the fence state machine
+  // CLOSED (idempotent, local). An aborted collective fence rolls
+  // itself back on every rank that ABORTED, but a fence abort need not
+  // be unanimous — a victim that died after partially disseminating
+  // its notifies can let some survivors complete the fence while
+  // others roll back, leaving fence_active_ divergent across the
+  // group. recover()/rejoin() call this on every rank so the group
+  // re-enters its first post-recovery epoch from one agreed state.
+  void FenceReset();
 
   // Atomically swap the LOCAL shard's backing memory to `base` (same byte
   // length, already holding identical contents), freeing the old buffer if
@@ -779,6 +788,15 @@ class Store {
                  int64_t src_seq);
   // The peer the most recent retry-layer failure named (-1 unknown).
   int LastFailedPeer() const;
+
+  // Shared tail of every failed collective (barrier / epoch fence):
+  // when the transport's detector abort classified kErrPeerLost, pull
+  // the named peer out of the transport, mark it suspected (the same
+  // registry data-path verdicts feed, so subsequent reads fail over /
+  // short-circuit immediately) and record it in the store-level retry
+  // stats so the Python layer's classify names the dead member
+  // uniformly across backends.
+  void NoteCollectiveFailure(int rc);
 
   // -- integrity internals -------------------------------------------------
 

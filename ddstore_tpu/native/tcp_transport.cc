@@ -418,6 +418,10 @@ TcpTransport::TcpTransport(int rank, int world, int port)
   // as a fallback alias so existing deployments keep their setting).
   unsigned hw = std::thread::hardware_concurrency();
   hw_cores_ = hw ? hw : 1;
+  // Control-plane retry knobs, resolved once (control ops run under
+  // PingConn::mu; no getenv per round trip).
+  control_timeout_ms_ = ControlTimeoutMsFromEnv();
+  control_retry_max_ = ControlRetryMaxFromEnv();
   long nconn = EnvLong(
       "DDSTORE_TCP_LANES",
       EnvLong("DDSTORE_CONNS_PER_PEER", hw >= 8 ? 4 : (hw >= 4 ? 2 : 1)));
@@ -740,6 +744,36 @@ void TcpTransport::HandleConnection(int fd) {
           // sleep: teardown must not wait out a stall.
           FaultSleepMs(fdec.param_ms, &stopping_);
         }
+      }
+    }
+
+    // Control-plane injector arm (ctrl-reset/ctrl-delay/ctrl-stall):
+    // the request/response CONTROL ops only. kOpPing stays clean — the
+    // detector's verdict schedule must not depend on chaos config —
+    // and kOpBarrier notifies are one-way frames with no retry story
+    // (the barrier's chaos vehicle is the detector abort, not a lost
+    // notify). Draws come from the injector's SEPARATE ctrl counter
+    // domain, so the data-plane schedules above are bit-identical with
+    // this arm present or absent.
+    if (req.op == kOpVarSeq || req.op == kOpRowSums ||
+        req.op == kOpSnapPin || req.op == kOpSnapUnpin) {
+      FaultInjector& fi = FaultInjector::Get();
+      if (fi.enabled()) {
+        const FaultDecision fdec = fi.DrawCtrl(rank_);
+        if (fdec.kind == FaultKind::kReset) {
+          // Drop the control connection pre-response: the client's
+          // ControlRoundTrip fails its recv, closes, and its bounded
+          // control-retry loop redials.
+          ::shutdown(fd, SHUT_RDWR);
+          return;
+        }
+        if (fdec.kind == FaultKind::kDelay ||
+            fdec.kind == FaultKind::kStall)
+          // Stall (default 2 s) is meant to outlive the client's
+          // DDSTORE_CONTROL_TIMEOUT_MS so its recv times out and the
+          // retry redials; delay just serves late. Sliced sleep:
+          // teardown must not wait out a stall.
+          FaultSleepMs(fdec.param_ms, &stopping_);
       }
     }
 
@@ -1254,14 +1288,21 @@ bool TcpTransport::ControlRoundTrip(PingConn& pc, uint32_t op,
   return true;
 }
 
+std::function<bool(int)> TcpTransport::SuspectSnapshot() {
+  std::lock_guard<std::mutex> lock(oracle_mu_);
+  return suspect_oracle_;
+}
+
 bool TcpTransport::Ping(int target, long timeout_ms) {
   if (target < 0 || target >= world_ || target == rank_) return true;
   if (timeout_ms < 50) timeout_ms = 50;
   PingConn& pc = *ping_conns_[target];
-  // Blocking lock: a concurrent ReadVarSeq holds this for at most its
-  // own bounded round-trip, and a contended probe must WAIT and then
-  // truly measure — returning "alive" for a probe that never ran would
-  // reset the failure streak and stretch detection past the
+  // Blocking lock: a concurrent control op holds this for at most ONE
+  // attempt's bounded round trip (the control-retry loops release it
+  // across their backoff sleeps precisely so pings queue behind one
+  // round trip, never a whole ladder), and a contended probe must WAIT
+  // and then truly measure — returning "alive" for a probe that never
+  // ran would reset the failure streak and stretch detection past the
   // HEARTBEAT_MS * SUSPECT_N bound the tests assert.
   std::lock_guard<std::mutex> lock(pc.mu);
   // Endpoints not exchanged yet: liveness is undecidable, and the
@@ -1275,15 +1316,30 @@ bool TcpTransport::Ping(int target, long timeout_ms) {
 
 int64_t TcpTransport::ReadVarSeq(int target, const std::string& name) {
   if (target < 0 || target >= world_ || target == rank_) return -1;
+  const std::function<bool(int)> suspect = SuspectSnapshot();
   PingConn& pc = *ping_conns_[target];
-  std::lock_guard<std::mutex> lock(pc.mu);
-  if (pc.port < 0 || pc.hosts.empty()) return -1;
   WireResp resp;
-  if (!ControlRoundTrip(pc, kOpVarSeq, name, /*timeout_ms=*/1000,
-                        &resp) ||
-      resp.status != kOk)
-    return -1;
-  return resp.nbytes;
+  // Bounded control retry (the RetryTransientLoop contract scaled to
+  // control ops): suspect short-circuit before every attempt, redial +
+  // short backoff between attempts. pc.mu is scoped to ONE attempt —
+  // a heartbeat ping must never queue behind a whole retry ladder's
+  // backoff sleeps, only behind one bounded round trip. The caller's
+  // -1 contract ("pull unconditionally") is the safe terminal state.
+  for (int att = 0;; ++att) {
+    if (suspect && suspect(target)) return -1;
+    if (stopping_.load(std::memory_order_relaxed)) return -1;
+    bool ok;
+    {
+      std::lock_guard<std::mutex> lock(pc.mu);
+      if (pc.port < 0 || pc.hosts.empty()) return -1;
+      ok = ControlRoundTrip(pc, kOpVarSeq, name, control_timeout_ms_,
+                            &resp);
+    }
+    if (ok) break;
+    if (att >= control_retry_max_) return -1;
+    FaultSleepMs(ControlBackoffMs(att), &stopping_);
+  }
+  return resp.status == kOk ? resp.nbytes : -1;
 }
 
 int TcpTransport::ReadRowSums(int target, const std::string& name,
@@ -1292,16 +1348,35 @@ int TcpTransport::ReadRowSums(int target, const std::string& name,
   if (target < 0 || target >= world_ || target == rank_ || count < 0 ||
       row0 < 0 || !seq || !sums)
     return kErrInvalidArg;
+  const std::function<bool(int)> suspect = SuspectSnapshot();
   PingConn& pc = *ping_conns_[target];
-  std::lock_guard<std::mutex> lock(pc.mu);
-  if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
   WireResp resp;
   std::string payload;
-  if (!ControlRoundTrip(pc, kOpRowSums, name, /*timeout_ms=*/5000,
-                        &resp, /*tag=*/0, /*offset=*/row0,
-                        /*nbytes=*/count, &payload,
-                        /*payload_cap=*/8 + count * 8))
-    return kErrTransport;
+  // 5x the base control deadline: a sum fetch carries a BULK payload
+  // (up to 512 KiB per 65536-row chunk), not a bare ack — at the
+  // 1000 ms default this is exactly the old 5000 ms one-shot window,
+  // and a retry restarting the transfer from zero must not be capped
+  // tighter than the transfer itself.
+  const long sums_timeout_ms = control_timeout_ms_ * 5;
+  for (int att = 0;; ++att) {
+    // A detector-declared-dead owner classifies as the bounded "peer
+    // is gone" signal, without burning the control budget against a
+    // corpse; plain exhaustion stays kErrTransport (slow != dead).
+    if (suspect && suspect(target)) return kErrPeerLost;
+    if (stopping_.load(std::memory_order_relaxed)) return kErrTransport;
+    bool ok;
+    {
+      std::lock_guard<std::mutex> lock(pc.mu);
+      if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
+      ok = ControlRoundTrip(pc, kOpRowSums, name, sums_timeout_ms,
+                            &resp, /*tag=*/0, /*offset=*/row0,
+                            /*nbytes=*/count, &payload,
+                            /*payload_cap=*/8 + count * 8);
+    }
+    if (ok) break;
+    if (att >= control_retry_max_) return kErrTransport;
+    FaultSleepMs(ControlBackoffMs(att), &stopping_);
+  }
   // A peer without integrity enabled answers kErrNotFound in-band —
   // "unverifiable", not a transport fault; the connection stays up.
   if (resp.status != kOk) return resp.status;
@@ -1317,13 +1392,27 @@ int TcpTransport::SnapshotControl(int target, int64_t snap_id, bool pin,
                                   const std::string& tenant) {
   if (target < 0 || target >= world_ || target == rank_)
     return kErrInvalidArg;
+  const std::function<bool(int)> suspect = SuspectSnapshot();
   PingConn& pc = *ping_conns_[target];
-  std::lock_guard<std::mutex> lock(pc.mu);
-  if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
   WireResp resp;
-  if (!ControlRoundTrip(pc, pin ? kOpSnapPin : kOpSnapUnpin, tenant,
-                        /*timeout_ms=*/5000, &resp, snap_id))
-    return kErrTransport;
+  for (int att = 0;; ++att) {
+    // kErrPeerLost (not kErrTransport) for a detector-declared-dead
+    // target: SnapshotAcquire's all-or-nothing rollback (partial-pin
+    // unwind) engages immediately with the classified signal.
+    if (suspect && suspect(target)) return kErrPeerLost;
+    if (stopping_.load(std::memory_order_relaxed)) return kErrTransport;
+    bool ok;
+    {
+      std::lock_guard<std::mutex> lock(pc.mu);
+      if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
+      ok = ControlRoundTrip(pc, pin ? kOpSnapPin : kOpSnapUnpin,
+                            tenant, control_timeout_ms_, &resp,
+                            snap_id);
+    }
+    if (ok) break;
+    if (att >= control_retry_max_) return kErrTransport;
+    FaultSleepMs(ControlBackoffMs(att), &stopping_);
+  }
   return resp.status;
 }
 
@@ -2351,9 +2440,16 @@ int TcpTransport::Barrier(int64_t tag) {
   // Notify failures are not immediately fatal: the common benign case is
   // a peer that already passed this barrier and tore down — the
   // information it owed us was delivered before it exited. A peer that
-  // truly died early can never notify us, and the per-round wait timeout
-  // surfaces that as kErrTransport with the expected sender named
-  // (failure detection; the reference has none, SURVEY §5).
+  // truly died early can never notify us; the FAILURE DETECTOR surfaces
+  // that in O(heartbeat): the per-round wait polls the store's suspect
+  // oracle and aborts with kErrPeerLost naming the suspect the moment
+  // any group member is declared dead (dissemination is transitive — a
+  // dead member anywhere means this barrier can never complete). The
+  // flat DDSTORE_BARRIER_TIMEOUT_S stays as the backstop for a peer
+  // that is silent but never suspected (detector off, R=1 default):
+  // that timeout keeps the old kErrTransport classification — slow is
+  // not dead. (The reference has no failure detection at all, SURVEY
+  // §5.)
   long timeout_s = 300;
   if (const char* env = ::getenv("DDSTORE_BARRIER_TIMEOUT_S")) {
     char* end = nullptr;
@@ -2367,6 +2463,11 @@ int TcpTransport::Barrier(int64_t tag) {
     std::lock_guard<std::mutex> lock(barrier_mu_);
     seq = ++barrier_seq_;
   }
+  const std::function<bool(int)> suspect = SuspectSnapshot();
+  const bool traced = trace::Enabled();
+  const uint64_t span = traced ? trace::NewSpan(rank_) : 0;
+  if (traced)
+    trace::Emit(trace::kBarrier, span, rank_, seq, tag, rounds);
 
   int result = kOk;
   for (int k = 0; k < rounds; ++k) {
@@ -2376,21 +2477,91 @@ int TcpTransport::Barrier(int64_t tag) {
       std::fprintf(stderr, "[dds r%d] barrier tag=%lld seq=%lld notify "
                    "r%d failed\n", rank_, static_cast<long long>(tag),
                    static_cast<long long>(seq), to);
-    std::unique_lock<std::mutex> lock(barrier_mu_);
-    bool ok = barrier_cv_.wait_for(
-        lock, std::chrono::seconds(timeout_s), [&] {
-          auto it = barrier_arrived_.find({seq, k});
-          return it != barrier_arrived_.end() && it->second >= 1;
-        });
+    bool ok = false;
+    int lost = -1;
+    bool lost_final = false;
+    {
+      std::unique_lock<std::mutex> lock(barrier_mu_);
+      const auto deadline = std::chrono::steady_clock::now() +
+                            std::chrono::seconds(timeout_s);
+      // Grace between "a member is suspected" and "abort": a member
+      // that completed this barrier and tore down cleanly (the benign
+      // staggered-teardown case) reads as dead to the detector, but
+      // every notify it owed the group was already SENT — the wait
+      // just needs the in-flight deliveries to land (milliseconds),
+      // not a fabricated kErrPeerLost. A truly dead member's missing
+      // notifies never arrive, so the grace only adds one bounded
+      // beat to detection — still O(heartbeat), never O(timeout).
+      constexpr auto kSuspectGrace = std::chrono::milliseconds(250);
+      std::chrono::steady_clock::time_point lost_since;
+      for (;;) {
+        auto it = barrier_arrived_.find({seq, k});
+        if (it != barrier_arrived_.end() && it->second >= 1) {
+          ok = true;
+          break;
+        }
+        // Suspect poll (lock-free atomic loads into the health
+        // registry; barrier_mu_ is DDS_NO_BLOCKING and stays so):
+        // ANY suspected member dooms the collective, not just this
+        // round's sender — its notifies are transitive inputs to
+        // every later round on some rank.
+        if (suspect) {
+          int s = -1;
+          for (int t = 0; t < world_ && s < 0; ++t)
+            if (t != rank_ && suspect(t)) s = t;
+          const auto now = std::chrono::steady_clock::now();
+          if (s < 0) {
+            lost = -1;  // verdict cleared (peer healed): keep waiting
+          } else if (s != lost) {
+            lost = s;
+            lost_since = now;
+          } else if (now - lost_since >= kSuspectGrace) {
+            lost_final = true;
+            break;
+          }
+        }
+        const auto now = std::chrono::steady_clock::now();
+        if (now >= deadline) break;
+        const auto slice = std::chrono::milliseconds(20);
+        const auto left = deadline - now;
+        barrier_cv_.wait_for(lock, left < slice ? left : slice);
+      }
+    }
+    if (lost_final && lost >= 0) {
+      // Detector abort: O(heartbeat) after the death, never
+      // O(BARRIER_TIMEOUT). Name the suspect for the Python layer's
+      // classify → elastic.recover handoff (same channel the data
+      // path's ladder verdicts use) — no giveup counted: the budget
+      // was not burned, the detector beat it.
+      retry_.last_peer.store(lost);
+      std::fprintf(stderr, "[dds r%d] barrier tag=%lld seq=%lld round "
+                   "%d/%d aborted: peer r%d suspected dead (round "
+                   "sender r%d)\n", rank_, static_cast<long long>(tag),
+                   static_cast<long long>(seq), k, rounds, lost, from);
+      if (traced) {
+        trace::Emit(trace::kBarrierAbort, span, rank_, seq, k, lost);
+        trace::ScopedSpan ss(span);
+        trace::Flight(trace::kReasonBarrierAbort, rank_);
+      }
+      result = kErrPeerLost;
+      break;
+    }
     if (!ok) {
       std::fprintf(stderr, "[dds r%d] barrier tag=%lld seq=%lld round "
                    "%d/%d timed out after %lds waiting for r%d\n", rank_,
                    static_cast<long long>(tag),
                    static_cast<long long>(seq), k, rounds, timeout_s, from);
+      if (traced) {
+        trace::Emit(trace::kBarrierAbort, span, rank_, seq, k, -1);
+        trace::ScopedSpan ss(span);
+        trace::Flight(trace::kReasonBarrierAbort, rank_);
+      }
       result = kErrTransport;
       break;
     }
   }
+  if (traced && result == kOk)
+    trace::Emit(trace::kBarrierDone, span, rank_, seq, tag, rounds);
   // Retire the seq win or lose: erase every entry at or below it and
   // raise the high-water mark so a straggler's late notify is dropped
   // instead of recreating (and leaking) an entry.

@@ -194,8 +194,10 @@ class TcpTransport : public Transport {
       DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // Content-version probe of a peer's shard, over the SAME dedicated
   // control-plane connection the heartbeat uses (never a data lane, no
-  // fault-injector draw). -1 on any failure — the mirror refresh then
-  // pulls unconditionally, the safe default.
+  // DATA-PLANE fault-injector draw — the server side draws from the
+  // separate ctrl domain, and this client side absorbs those faults
+  // with the bounded ControlRetry contract below). -1 on any failure —
+  // the mirror refresh then pulls unconditionally, the safe default.
   int64_t ReadVarSeq(int target, const std::string& name) override
       DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // Integrity sum fetch (kOpRowSums), over the same dedicated control
@@ -242,7 +244,12 @@ class TcpTransport : public Transport {
   void RetryCounters(int64_t out[7]) const { retry_.Snapshot(out); }
   // Dissemination barrier: ceil(log2 P) one-way notify rounds per fence
   // (round k: notify rank+2^k, wait for rank-2^k) instead of the round-1
-  // flat O(P) notify loop / O(P^2) total messages.
+  // flat O(P) notify loop / O(P^2) total messages. FAILURE-AWARE: the
+  // per-round wait polls the store's suspect oracle, so a member the
+  // detector declared dead aborts the whole barrier in O(heartbeat)
+  // with kErrPeerLost naming the suspect (retry_.last_peer), instead
+  // of sleeping out DDSTORE_BARRIER_TIMEOUT_S per round. A timeout
+  // with NO suspect stays kErrTransport (the peer may just be slow).
   int Barrier(int64_t tag) override;
   int rank() const override { return rank_; }
   int world() const override { return world_; }
@@ -405,6 +412,18 @@ class TcpTransport : public Transport {
                         int64_t nbytes = 0, std::string* payload = nullptr,
                         int64_t payload_cap = 0)
       DDS_REQUIRES(PingConn::mu);
+  // Snapshot the store-installed suspect oracle (one oracle_mu_
+  // acquisition; the returned callable is lock-free). Null when no
+  // store attached / single rank. Consumed by the barrier wait and the
+  // control-op retry loops: everything on the PingConn EXCEPT the
+  // heartbeat Ping itself carries the RetryTransientLoop contract
+  // scaled down to control ops — a detector-declared-dead peer
+  // short-circuits BEFORE any dial (a fence's var-seq probes and a
+  // snapshot acquire's pin placement must not serially burn per-peer
+  // control timeouts against a corpse), and a transport-failed round
+  // trip redials and retries up to control_retry_max_ times with short
+  // bounded backoff (ControlBackoffMs).
+  std::function<bool(int)> SuspectSnapshot();
 
   // Store-installed suspect oracle for the leaf retry layer (null =
   // never suspected). ReadVOnRetry snapshots it ONCE per leaf under
@@ -575,6 +594,12 @@ class TcpTransport : public Transport {
   RetryStats retry_;
   // Deadline override for leaf retries (nanos; 0 = none).
   std::atomic<int64_t> retry_deadline_ns_{0};
+
+  // Control-plane round-trip knobs (DDSTORE_CONTROL_TIMEOUT_MS /
+  // DDSTORE_CONTROL_RETRY_MAX), resolved once at construction —
+  // control ops run under PingConn::mu and must not getenv per call.
+  long control_timeout_ms_ = 1000;
+  int control_retry_max_ = 2;
 
   // Barrier bookkeeping. Caller tags come from independent subsystems
   // (epoch fences, the Python-layer barrier) and are NOT globally ordered,

@@ -76,6 +76,11 @@ enum EventType : uint16_t {
                        // row, c=serving holder (-1 = the primary)
   kScrub = 22,         // one mirror scrubbed: a=rows, b=divergent rows,
                        // c=1 if re-pulled (repaired)
+  kBarrier = 23,       // collective entered: a=barrier seq, b=caller
+                       // tag, c=dissemination rounds
+  kBarrierDone = 24,   // collective completed: a=seq, b=tag, c=rounds
+  kBarrierAbort = 25,  // collective aborted: a=seq, b=round,
+                       // c=suspected-dead peer (-1 = plain timeout)
 };
 
 // Op classes for kOpBegin/kOpEnd `a`. Keep in sync with binding.py
@@ -96,6 +101,7 @@ enum FlightReason : int {
   kReasonSuspect = 4,
   kReasonManual = 5,
   kReasonCorrupt = 6,
+  kReasonBarrierAbort = 7,
 };
 
 // The fixed-size dump record (48 bytes, packed, little-endian on every

@@ -72,6 +72,17 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_CMA", "config", desc="0 disables the CMA fast path "
        "entirely (a capability switch, not a per-class preference)"),
     _k("DDSTORE_CONNECT_TIMEOUT_S", "config"),
+    _k("DDSTORE_CONTROL_RETRY_MAX", "config",
+       desc="bounded retry budget for control-plane round trips "
+            "(var-seq probes, row-sum fetches, snapshot pin "
+            "placement); default 2; the suspect oracle short-circuits "
+            "a detector-declared-dead peer before any attempt"),
+    _k("DDSTORE_CONTROL_TIMEOUT_MS", "config",
+       desc="per-attempt deadline (ms) for control-plane round trips; "
+            "default 1000 — replaces the old hardcoded one-shot "
+            "1000/5000 ms kOpVarSeq/kOpRowSums timeouts (bulk row-sum "
+            "fetches run at 5x this value per attempt, preserving the "
+            "old window at the default)"),
     _k("DDSTORE_COORDINATOR", "config"),
     _k("DDSTORE_CXX", "config",
        desc="C++ compiler for the on-demand native build (default g++)"),

@@ -323,8 +323,7 @@ class DDStore:
         # `add` is collective in the reference (MPI_Win_create,
         # ddstore.hpp:56-62); completing it with a barrier gives the same
         # guarantee: once any rank returns, every shard is readable.
-        self.barrier()
-        self._replicate_after_add(name)
+        self._finish_collective_add(name)
 
     def init(self, name: str, nrows: int, sample_shape: Tuple[int, ...],
              dtype) -> None:
@@ -343,8 +342,42 @@ class DDStore:
                           dtype.itemsize, all_nrows)
         self._meta[name] = _VarMeta(dtype, tuple(sample_shape), disp,
                                     all_nrows)
-        self.barrier()
-        self._replicate_after_add(name)
+        self._finish_collective_add(name)
+
+    def _finish_collective_add(self, name: str) -> None:
+        """The barrier → replicate → barrier tail of ``add``/``init``,
+        made CRASH-CONSISTENT: a peer DEATH mid-fence (the barrier
+        aborts with the classified ``ERR_PEER_LOST``, in O(heartbeat)
+        when the detector is on) rolls the LOCAL registration back —
+        native variable freed, metadata dropped — before re-raising.
+        In the common case every survivor's oracle converges on the
+        same dead member and all of them abort the same fence, so a
+        subsequent ``elastic.recover`` + retried ``add`` finds the
+        clean pre-add state everywhere — no half-registered variable
+        poisoning later collectives with ``ERR_EXISTS`` on some ranks
+        only. The abort is not GUARANTEED unanimous (a victim that
+        partially disseminated its barrier notifies can let one
+        survivor complete the fence others aborted — the same window
+        the fence state machine heals with ``fence_reset`` at
+        recovery); a retried ``add`` that hits ``ERR_EXISTS`` on such
+        a completed rank is realigned by a collective ``free(name)`` +
+        re-add. A plain barrier TIMEOUT (``ERR_TRANSPORT``, no
+        suspect) deliberately does NOT unwind: a slow-but-alive peer
+        may have completed the fence and kept the variable, and a
+        one-sided rollback would widen exactly that divergence (the
+        pre-hardening behavior — keep the registration, surface the
+        error)."""
+        try:
+            self.barrier()
+            self._replicate_after_add(name)
+        except DDStoreError as e:
+            if e.code == ERR_PEER_LOST:
+                try:
+                    self._native.free_var(self._wname(name))
+                except DDStoreError:
+                    pass  # best-effort rollback; the raise is the news
+                self._meta.pop(name, None)
+            raise
 
     def _replicate_after_add(self, name: str) -> None:
         """R-way shard replication (``DDSTORE_REPLICATION``): after the
@@ -628,7 +661,21 @@ class DDStore:
             if len(lengths) else np.empty((0,), np.int64)
         index = np.stack([starts, lengths], axis=1) if len(lengths) \
             else np.empty((0, 2), np.int64)
-        self.add(f"{name}/index", index.astype(np.int64))
+        try:
+            self.add(f"{name}/index", index.astype(np.int64))
+        except DDStoreError as e:
+            # Ragged-level crash consistency: each add() already
+            # unwinds ITSELF on a death mid-fence, but a death during
+            # the SECOND add would otherwise leave the values half of
+            # the pair registered — a partial ragged variable
+            # is_ragged() rejects yet whose shard RAM lingers.
+            if e.code == ERR_PEER_LOST:
+                try:
+                    self._native.free_var(self._wname(f"{name}/values"))
+                except DDStoreError:
+                    pass  # best-effort; the raise below is the news
+                self._meta.pop(f"{name}/values", None)
+            raise
 
     def is_ragged(self, name: str) -> bool:
         return f"{name}/index" in self._meta and f"{name}/values" in self._meta
@@ -727,16 +774,60 @@ class DDStore:
 
     # -- epochs / sync -----------------------------------------------------
 
+    def _classify_collective(self, e: DDStoreError,
+                             what: str) -> DDStoreError:
+        """Collective-failure analogue of :meth:`_classify`: a barrier
+        or epoch fence aborted by the failure detector surfaces
+        ``ERR_PEER_LOST`` naming the dead member (the native side
+        already rolled the fence state machine back and fed the suspect
+        registry), and the fix is the same elastic.recover handoff a
+        lost read gets. A plain timeout (no suspect) passes through as
+        the generic transport error — slow is not dead."""
+        if e.code != ERR_PEER_LOST:
+            return e
+        peer = int(self._native.fault_stats().get("last_error_peer", -1))
+        suspects = self.suspected_peers()
+        return DDStoreError(
+            e.code,
+            f"{what}: peer rank {peer} died mid-collective (suspected: "
+            f"{suspects}) — detected by the failure detector in "
+            f"O(heartbeat), not a {what} timeout; the collective was "
+            f"rolled back to a recoverable state. Invoke "
+            f"elastic.recover, then re-enter the epoch/collective")
+
     def epoch_begin(self) -> None:
-        self._native.epoch_begin()
+        try:
+            self._native.epoch_begin()
+        except DDStoreError as e:
+            raise self._classify_collective(e, "epoch_begin") from None
 
     def epoch_end(self) -> None:
-        self._native.epoch_end()
+        try:
+            self._native.epoch_end()
+        except DDStoreError as e:
+            raise self._classify_collective(e, "epoch_end") from None
+
+    def fence_reset(self) -> None:
+        """Force the epoch-fence state machine closed (local,
+        idempotent). A fence abort need not be unanimous — a victim
+        that died after partially disseminating its barrier notifies
+        can let some survivors COMPLETE the fence while others roll
+        back — so :func:`elastic.recover` calls this on every rank,
+        realigning the group on one pre-fence state before the first
+        post-recovery epoch."""
+        self._native.fence_reset()
 
     def barrier(self) -> None:
-        """Collective barrier over the store group (data-plane, cheap)."""
+        """Collective barrier over the store group (data-plane, cheap).
+        Failure-aware: a member the heartbeat/ladder already declared
+        dead aborts the wait in O(heartbeat) with the classified
+        ``ERR_PEER_LOST`` naming it, never a flat
+        ``DDSTORE_BARRIER_TIMEOUT_S`` sleep."""
         self._barrier_tag += 1
-        self._native.barrier(self._barrier_tag)
+        try:
+            self._native.barrier(self._barrier_tag)
+        except DDStoreError as e:
+            raise self._classify_collective(e, "barrier") from None
 
     # -- teardown ----------------------------------------------------------
 

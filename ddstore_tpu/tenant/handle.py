@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..binding import DDStoreError
+from ..binding import DDStoreError, ERR_PEER_LOST
 from ..store import DDStore
 
 #: native namespace separators. Scoped names are built HERE and only
@@ -100,7 +100,26 @@ class TenantHandle(DDStore):
                       else parent._tenant_meta.setdefault(tenant, {}))
         self._snap_id: Optional[int] = None
         if snapshot:
-            self._snap_id = self._native.snapshot_acquire(tenant)
+            try:
+                self._snap_id = self._native.snapshot_acquire(tenant)
+            except DDStoreError as e:
+                if e.code == ERR_PEER_LOST:
+                    # Rank-by-rank pin placement met a dead peer: the
+                    # native acquire UNWOUND the pins it had placed
+                    # (all-or-nothing, with one retry pass per live
+                    # peer) — best-effort under control-plane chaos: a
+                    # pin on a live peer whose unpin failed every
+                    # attempt is released when that peer's store
+                    # closes. Re-attach after recovery.
+                    raise DDStoreError(
+                        e.code,
+                        f"attach(tenant={tenant!r}, snapshot=True): a "
+                        f"peer died during rank-by-rank snapshot-pin "
+                        f"placement; the partially placed pins were "
+                        f"unwound (best-effort on unreachable live "
+                        f"peers). Recover the dead rank "
+                        f"(elastic.recover), then re-attach") from None
+                raise
 
     # -- name scoping ------------------------------------------------------
 
