@@ -18,10 +18,10 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
 _SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
             "worker_pool.cc", "cma.cc", "fault.cc", "health.cc",
-            "integrity.cc", "trace.cc", "capi.cc"]
+            "integrity.cc", "tier.cc", "trace.cc", "capi.cc"]
 _HEADERS = ["store.h", "local_transport.h", "tcp_transport.h",
             "worker_pool.h", "cma.h", "fault.h", "health.h",
-            "integrity.h", "measure.h", "trace.h",
+            "integrity.h", "measure.h", "tier.h", "trace.h",
             "thread_annotations.h"]
 _lock = threading.Lock()
 

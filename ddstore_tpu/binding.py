@@ -192,6 +192,23 @@ def _load() -> ctypes.CDLL:
                                        _i64p]
     lib.dds_integrity_scrub.restype = ctypes.c_int
     lib.dds_integrity_scrub.argtypes = [ctypes.c_void_p]
+    lib.dds_tier_configure.restype = ctypes.c_int
+    lib.dds_tier_configure.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_set_var_tier.restype = ctypes.c_int
+    lib.dds_set_var_tier.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+    lib.dds_var_tier.restype = ctypes.c_int
+    lib.dds_var_tier.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dds_set_tier_placement.restype = ctypes.c_int
+    lib.dds_set_tier_placement.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p, ctypes.c_int]
+    lib.dds_cache_prefetch.restype = _i64
+    lib.dds_cache_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       _i64p, _i64, _i64, ctypes.c_char_p]
+    lib.dds_cache_evict.restype = ctypes.c_int
+    lib.dds_cache_evict.argtypes = [ctypes.c_void_p, _i64]
+    lib.dds_tiering_stats.restype = ctypes.c_int
+    lib.dds_tiering_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_trace_configure.restype = ctypes.c_int
     lib.dds_trace_configure.argtypes = [ctypes.c_int, ctypes.c_long]
     lib.dds_trace_enabled.restype = ctypes.c_int
@@ -317,7 +334,8 @@ TRACE_TYPES = {
     15: "suspect", 16: "suspect_clear", 17: "quota_reject",
     18: "lane_budget_rotate", 19: "flight", 20: "failover",
     21: "verify_fail", 22: "scrub", 23: "barrier", 24: "barrier_done",
-    25: "barrier_abort",
+    25: "barrier_abort", 26: "cache_fill", 27: "cache_hit",
+    28: "cache_evict",
 }
 #: name -> code view of :data:`TRACE_TYPES` (Python-side emitters).
 TRACE_TYPE_CODES = {v: k for k, v in TRACE_TYPES.items()}
@@ -497,6 +515,25 @@ INTEGRITY_STAT_KEYS = (
 
 #: the gauge subset of :data:`INTEGRITY_STAT_KEYS` (never delta'd).
 INTEGRITY_GAUGE_KEYS = ("verify_mode", "sums_tables", "last_corrupt_peer")
+
+
+#: dict keys of :meth:`NativeStore.tiering_stats`, in native layout
+#: order (keep in sync with capi dds_tiering_stats /
+#: Store::TieringStats). The first five are GAUGES (cache budget and
+#: occupancy, cold-tier registrations); everything else is monotone
+#: since store creation (PipelineMetrics diffs those per epoch into
+#: ``summary()["tiering"]``).
+TIERING_STAT_KEYS = (
+    "cache_max_bytes", "cache_bytes", "cache_entries", "cold_vars",
+    "cold_bytes", "cache_hits", "cache_hit_bytes", "cache_misses",
+    "cache_miss_bytes", "cache_fills", "cache_fill_bytes",
+    "cache_fill_failures", "cache_evictions", "cache_evicted_bytes",
+    "cache_over_budget", "cache_prefetches",
+)
+
+#: the gauge subset of :data:`TIERING_STAT_KEYS` (never delta'd).
+TIERING_GAUGE_KEYS = ("cache_max_bytes", "cache_bytes", "cache_entries",
+                      "cold_vars", "cold_bytes")
 
 
 def _as_i64p(arr: np.ndarray):
@@ -1063,6 +1100,71 @@ class NativeStore:
         if n < 0:
             raise DDStoreError(n, "integrity_scrub")
         return n
+
+    # -- tiered storage: hot-row cache + cold placement --------------------
+
+    def tier_configure(self, cache_bytes: int = -1) -> None:
+        """Runtime hot-row cache budget (bytes; 0 disables and evicts
+        everything, < 0 keeps). Load-time:
+        ``DDSTORE_TIER_CACHE_BYTES``."""
+        _check(self._lib.dds_tier_configure(self._h, int(cache_bytes)),
+               f"tier_configure({cache_bytes})")
+
+    def set_var_tier(self, name: str, tier: int) -> None:
+        """Record a registered variable's storage tier (0 = hot
+        RAM/shm, 1 = cold file-backed mmap). Drives the
+        ``cold_vars``/``cold_bytes`` gauges; serving is tier-agnostic."""
+        _check(self._lib.dds_set_var_tier(self._h, name.encode(),
+                                          int(tier)),
+               f"set_var_tier({name})")
+
+    def var_tier(self, name: str) -> int:
+        """The recorded tier of ``name`` (0 hot, 1 cold)."""
+        rc = int(self._lib.dds_var_tier(self._h, name.encode()))
+        if rc < 0:
+            raise DDStoreError(rc, f"var_tier({name})")
+        return rc
+
+    def set_tier_placement(self, tenant: str, cold: bool) -> None:
+        """Placement policy for ``tenant``'s mirror fills and snapshot
+        kept copies: cold lands them file-backed under
+        ``DDSTORE_TIER_COLD_DIR`` (load-time:
+        ``DDSTORE_TIER_PLACEMENT``)."""
+        _check(self._lib.dds_set_tier_placement(
+            self._h, tenant.encode(), 1 if cold else 0),
+            f"set_tier_placement({tenant})")
+
+    def cache_prefetch(self, name: str, rows, window: int = 0,
+                       tenant: str = "") -> None:
+        """Warm the hot-row cache with sorted-unique global ``rows`` of
+        ``name`` as window ``window`` (the eviction key); the fill runs
+        detached on the native async pool, charged against the reading
+        ``tenant``'s byte quota until eviction. Advisory: disabled /
+        duplicate / over-budget calls are counted no-ops."""
+        idx = np.ascontiguousarray(rows, dtype=np.int64).reshape(-1)
+        rc = int(self._lib.dds_cache_prefetch(
+            self._h, name.encode(), _as_i64p(idx), idx.size,
+            int(window), tenant.encode()))
+        if rc < 0:
+            raise DDStoreError(rc, f"cache_prefetch({name})")
+
+    def cache_evict(self, window: int = -1) -> int:
+        """Evict window ``window``'s cache entries (< 0: every entry),
+        releasing their quota charges. Returns the count evicted."""
+        rc = int(self._lib.dds_cache_evict(self._h, int(window)))
+        if rc < 0:
+            raise DDStoreError(rc, f"cache_evict({window})")
+        return rc
+
+    def tiering_stats(self) -> dict:
+        """Tiering counters (:data:`TIERING_STAT_KEYS`): cache budget/
+        occupancy gauges, cold-tier registrations, and the monotone
+        hit/miss/fill/evict ledger."""
+        arr = (ctypes.c_int64 * 16)()
+        _check(self._lib.dds_tiering_stats(self._h, arr),
+               "tiering_stats")
+        return dict(zip(TIERING_STAT_KEYS,
+                        list(arr)[:len(TIERING_STAT_KEYS)]))
 
     def fault_stats(self) -> dict:
         """Fault-injection + transient-retry counters: the process-global

@@ -187,6 +187,13 @@ class DeviceLoader:
             # or scrubbing is in force (inert — and absent from the
             # summary — while both are off).
             self.metrics.set_integrity_source(store.integrity_stats)
+        if store is not None and hasattr(store, "tiering_stats"):
+            # Tiered-storage ledger: summary()["tiering"] carries this
+            # epoch's hot-cache hit/miss/fill/evict deltas and the
+            # cold-tier gauges whenever the cache is armed or a cold
+            # variable is registered (inert — and absent from the
+            # summary — otherwise).
+            self.metrics.set_tiering_source(store.tiering_stats)
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether

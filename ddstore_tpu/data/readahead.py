@@ -37,6 +37,7 @@ import itertools
 import os
 import threading
 import time
+from collections import deque
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -151,16 +152,23 @@ def plan_epoch_windows(row_starts, batches: Iterable,
         plans.append(plan_window(row_starts, chunk))
 
 
+#: Per-process engine id counter: each engine's cache-prefetch window
+#: ids live in their own 2^32 range, so two engines (or two epochs)
+#: sharing one store can never alias each other's hot-cache entries.
+_ENGINE_IDS = itertools.count(1)
+
+
 class _Window:
     __slots__ = ("plan", "slot", "handles", "bufs", "ragged", "futures",
                  "delivered", "ready", "ready_mu", "t_issue", "span",
-                 "wnum")
+                 "wnum", "warmed")
 
     def __init__(self, plan: WindowPlan, slot: int):
         self.plan = plan
         self.slot = slot
         self.span = 0   # ddtrace span id of this window (0 = untraced)
         self.wnum = 0   # global window number
+        self.warmed = False  # hot-cache prefetch issued at plan time
         self.handles: Dict[str, object] = {}   # var -> AsyncBatchRead
         self.bufs: Dict[str, np.ndarray] = {}  # var -> staged view
         self.futures: Dict[str, object] = {}   # var -> Future (ragged)
@@ -253,6 +261,34 @@ class EpochReadahead:
             self._exec = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="ddstore-readahead")
 
+        # Tiered-storage warming: when the store's hot-row cache is
+        # armed (DDSTORE_TIER_CACHE_BYTES > 0), the issuer plans up to
+        # `_prefetch` windows AHEAD of issue and hands each plan's row
+        # list to store.cache_prefetch — a free lookahead (the plan
+        # exists before the window is issued), so by the time window w
+        # is issued its cold rows are already staged in RAM and the
+        # window read is an in-RAM gather. Eviction is keyed on window
+        # consumption (_mark_delivered). Window ids are scoped per
+        # engine so epochs/engines never alias entries.
+        self._warm = False
+        self._prefetch = 0
+        self._wid_base = next(_ENGINE_IDS) << 32
+        self._warmed: set = set()
+        if hasattr(store, "tiering_stats") and \
+                hasattr(store, "cache_prefetch"):
+            try:
+                self._warm = int(store.tiering_stats().get(
+                    "cache_max_bytes", 0)) > 0
+            except Exception:  # noqa: BLE001 — advisory capability probe
+                self._warm = False
+        if self._warm:
+            self._prefetch = self._default_prefetch()
+            self._warm = self._prefetch > 0
+
+        self._planned: "deque" = deque()  # (wnum, plan) awaiting issue
+        self._plan_next = 0               # next window number to plan
+        self._iter_done = False           # batch iterator exhausted
+
         self._mu = threading.Lock()
         self._cond = threading.Condition(self._mu)
         # Serializes degraded-window refetches: each one sets the
@@ -319,96 +355,194 @@ class EpochReadahead:
                 b.fill(0)
         self._max_rows = cap
 
+    def _default_prefetch(self) -> int:
+        """Requested warm-ahead depth: the DDSTORE_TIER_PREFETCH_DEPTH
+        pin, else 2 (the scheduler refines it against the cache budget
+        and its per-tier cells once the first plan's geometry is
+        known)."""
+        v = os.environ.get("DDSTORE_TIER_PREFETCH_DEPTH", "").strip()
+        if v:
+            try:
+                return max(0, int(v))
+            except ValueError:
+                pass
+        return 2
+
+    def _refine_prefetch(self, plan: WindowPlan) -> None:
+        """First plan: let the cost-model scheduler size the warm-ahead
+        depth from the real window geometry vs the cache budget (and
+        its measured hot-hit / cold-miss cells). A user pin wins inside
+        planned_prefetch; sched-less engines keep the default."""
+        if self.sched is None or \
+                not hasattr(self.sched, "planned_prefetch"):
+            return
+        wbytes = sum(int(plan.rows.size) * rb
+                     for rb in self._row_bytes.values())
+        try:
+            cache = int(self.store.tiering_stats().get(
+                "cache_max_bytes", 0))
+            self._prefetch = max(0, int(self.sched.planned_prefetch(
+                self._prefetch, wbytes, cache, self.depth)))
+        except Exception:  # noqa: BLE001 — advisory sizing only
+            pass
+        if self._prefetch == 0:
+            self._warm = False
+
+    def _warm_window(self, w: int, plan: WindowPlan) -> bool:
+        """Hand window ``w``'s row list to the store's hot cache
+        (advisory: any failure just leaves the window cold)."""
+        warmed = False
+        for v in self._vars:
+            if self._ragged[v]:
+                continue
+            try:
+                self.store.cache_prefetch(v, plan.rows,
+                                          window=self._wid_base + w)
+                warmed = True
+            except Exception:  # noqa: BLE001 — reads stay correct cold
+                return warmed
+        if warmed:
+            self._warmed.add(w)
+        return warmed
+
     def _issue_loop(self) -> None:
-        """Issuer thread: plan and issue window ``w`` as soon as its
-        ring slot's previous owner (window ``w - depth``) is consumed.
-        Planning happens OUTSIDE the engine lock — consumers gathering
+        """Issuer thread: PLAN up to ``1 + prefetch`` windows ahead
+        (warming the hot cache with each plan's row list the moment it
+        exists) and ISSUE the head window as soon as its ring slot's
+        previous owner (window ``w - depth``) is consumed. Planning and
+        warming happen OUTSIDE the engine lock — consumers gathering
         from staged windows never wait on a sort."""
         while True:
             with self._mu:
-                while (not self._closed and not self._exhausted
-                       and self._next_issue >= self._floor + self.depth):
-                    self._cond.wait()
-                if self._closed or self._exhausted:
-                    return
-                w = self._next_issue  # only this thread advances it
-            win = None
-            try:
-                chunk = list(itertools.islice(self._batch_iter,
-                                              self.window_batches))
-                if not chunk:
-                    with self._mu:
+                while True:
+                    if self._closed or self._error is not None:
+                        return
+                    cap = 1 + (self._prefetch if self._warm else 0)
+                    can_plan = (not self._iter_done
+                                and len(self._planned) < cap)
+                    can_issue = (bool(self._planned) and self._next_issue
+                                 < self._floor + self.depth)
+                    if can_plan or can_issue:
+                        break
+                    if self._iter_done and not self._planned:
                         self._exhausted = True
                         self._cond.notify_all()
-                    return
-                plan = plan_window(self._row_starts, chunk)
-                if not self._ring and not all(self._ragged.values()):
-                    self._alloc_ring(plan)
-                win = _Window(plan, w % self.depth)
-                n = int(plan.rows.size)
-                if self._max_rows is not None and n > self._max_rows:
-                    raise ValueError(
-                        f"readahead window {w} needs {n} staging rows "
-                        f"but the ring was sized for {self._max_rows} "
-                        f"(batches grew mid-epoch?)")
-                win.wnum = w
-                if trace_enabled():
-                    # ddtrace: one span per window — issue/ready/stall
-                    # events group under it in the merged trace, next
-                    # to the native async-read spans its fetches mint.
-                    rank = int(getattr(self.store, "rank", -1))
-                    win.span = trace_new_span(rank)
-                    trace_emit("window_issue", win.span, rank, w, n,
-                               sum(n * rb
-                                   for rb in self._row_bytes.values()))
-                win.t_issue = time.monotonic()
-                for v in self._vars:
-                    if self._ragged[v]:
-                        win.futures[v] = self._exec.submit(
-                            self._fetch_ragged, v, plan.rows)
-                    else:
-                        buf = self._ring[v][win.slot][:n]
-                        if self._use_runs[v]:
-                            tgt, soff, doff, nb = self._runs_for(v, plan)
-                            win.handles[v] = self.store.read_runs_async(
-                                v, buf, tgt, soff, doff, nb)
-                        else:
-                            win.handles[v] = self.store.get_batch_async(
-                                v, plan.rows, out=buf)
-                        win.bufs[v] = buf
+                        return
+                    self._cond.wait()
+            try:
+                # Issue first (the fetch should be in flight before the
+                # next plan's sort runs), then top up the plan buffer.
+                if can_issue:
+                    if not self._issue_one():
+                        return
+                elif not self._plan_one():
+                    continue  # iterator exhausted: loop decides the end
             except BaseException as e:  # noqa: BLE001
-                # A partially-issued window (e.g. the label variable's
-                # issue raised after the data read went in flight) must
-                # not leak its tickets: the window was never registered
-                # in _win, so close() cannot release them — and a leaked
-                # in-flight read would keep writing into a ring buffer a
-                # caller may hand to the next epoch's engine.
-                if win is not None:
-                    for h in win.handles.values():
-                        h.release()
-                    for f in win.futures.values():
-                        try:
-                            f.result()
-                        except BaseException:  # noqa: BLE001
-                            pass
                 with self._mu:
                     self._error = e
                     self._cond.notify_all()
                 return
+
+    def _plan_one(self) -> bool:
+        """Plan (and cache-warm) the next window; False when the batch
+        iterator is exhausted."""
+        chunk = list(itertools.islice(self._batch_iter,
+                                      self.window_batches))
+        if not chunk:
             with self._mu:
-                if self._closed:
-                    # close() ran mid-issue: this window is not in
-                    # _win, so release its reads here.
-                    handles = list(win.handles.values())
-                else:
-                    self._win[w] = win
-                    self._next_issue = w + 1
-                    handles = None
+                self._iter_done = True
                 self._cond.notify_all()
-            if handles is not None:
-                for h in handles:
+            return False
+        plan = plan_window(self._row_starts, chunk)
+        if not self._ring and not all(self._ragged.values()):
+            self._alloc_ring(plan)
+        w = self._plan_next
+        self._plan_next = w + 1
+        if self._warm:
+            if w == 0:
+                self._refine_prefetch(plan)
+            if self._warm:
+                self._warm_window(w, plan)
+        with self._mu:
+            self._planned.append((w, plan))
+            self._cond.notify_all()
+        return True
+
+    def _issue_one(self) -> bool:
+        """Issue the head planned window into its ring slot; False when
+        the engine closed mid-issue (tickets already released) or the
+        issue failed (error latched)."""
+        with self._mu:
+            w, plan = self._planned.popleft()
+        win = None
+        try:
+            win = _Window(plan, w % self.depth)
+            n = int(plan.rows.size)
+            if self._max_rows is not None and n > self._max_rows:
+                raise ValueError(
+                    f"readahead window {w} needs {n} staging rows "
+                    f"but the ring was sized for {self._max_rows} "
+                    f"(batches grew mid-epoch?)")
+            win.wnum = w
+            win.warmed = w in self._warmed
+            if trace_enabled():
+                # ddtrace: one span per window — issue/ready/stall
+                # events group under it in the merged trace, next
+                # to the native async-read spans its fetches mint.
+                rank = int(getattr(self.store, "rank", -1))
+                win.span = trace_new_span(rank)
+                trace_emit("window_issue", win.span, rank, w, n,
+                           sum(n * rb
+                               for rb in self._row_bytes.values()))
+            win.t_issue = time.monotonic()
+            for v in self._vars:
+                if self._ragged[v]:
+                    win.futures[v] = self._exec.submit(
+                        self._fetch_ragged, v, plan.rows)
+                else:
+                    buf = self._ring[v][win.slot][:n]
+                    if self._use_runs[v]:
+                        tgt, soff, doff, nb = self._runs_for(v, plan)
+                        win.handles[v] = self.store.read_runs_async(
+                            v, buf, tgt, soff, doff, nb)
+                    else:
+                        win.handles[v] = self.store.get_batch_async(
+                            v, plan.rows, out=buf)
+                    win.bufs[v] = buf
+        except BaseException as e:  # noqa: BLE001
+            # A partially-issued window (e.g. the label variable's
+            # issue raised after the data read went in flight) must
+            # not leak its tickets: the window was never registered
+            # in _win, so close() cannot release them — and a leaked
+            # in-flight read would keep writing into a ring buffer a
+            # caller may hand to the next epoch's engine.
+            if win is not None:
+                for h in win.handles.values():
                     h.release()
-                return
+                for f in win.futures.values():
+                    try:
+                        f.result()
+                    except BaseException:  # noqa: BLE001
+                        pass
+            with self._mu:
+                self._error = e
+                self._cond.notify_all()
+            return False
+        with self._mu:
+            if self._closed:
+                # close() ran mid-issue: this window is not in
+                # _win, so release its reads here.
+                handles = list(win.handles.values())
+            else:
+                self._win[w] = win
+                self._next_issue = w + 1
+                handles = None
+            self._cond.notify_all()
+        if handles is not None:
+            for h in handles:
+                h.release()
+            return False
+        return True
 
     def _runs_for(self, var: str, plan: WindowPlan):
         """The window's coalesced runs as native byte spans: targets,
@@ -600,6 +734,14 @@ class EpochReadahead:
         if self.sched is not None and fetch_s > 0.0:
             self.sched.observe_window(wbytes, fetch_s,
                                       cold=self._windows_fed == 0)
+            if self._warm and hasattr(self.sched, "observe_tier"):
+                # Per-tier read cells: a warmed window's fetch leg is
+                # the hot-hit regime (in-RAM gather), an unwarmed one
+                # the cold-miss regime — the cost model plans the
+                # prefetch depth from exactly these two cells.
+                self.sched.observe_tier(wbytes, fetch_s,
+                                        warmed=win.warmed,
+                                        cold=self._windows_fed == 0)
             self._windows_fed += 1
         m = self.metrics
         if m is None or not hasattr(m, "add_window"):
@@ -663,6 +805,7 @@ class EpochReadahead:
 
     def _mark_delivered(self, seq: int) -> None:
         w = int(seq) // self.window_batches
+        evict = None
         with self._mu:
             win = self._win.get(w)
             if win is None:
@@ -674,7 +817,18 @@ class EpochReadahead:
                 while self._floor in self._done_wins:
                     self._done_wins.discard(self._floor)
                     self._floor += 1
+                # Eviction keyed on window CONSUMPTION: the warmed
+                # entries served their window's fetch; the budget goes
+                # back to the windows streaming in behind it.
+                if w in self._warmed:
+                    self._warmed.discard(w)
+                    evict = self._wid_base + w
                 self._cond.notify_all()  # wake the issuer (slot freed)
+        if evict is not None:
+            try:
+                self.store.cache_evict(evict)
+            except Exception:  # noqa: BLE001 — eviction is advisory
+                pass
 
     def get_batch(self, seq: int, idx=None):
         """Deliver batch ``seq`` (global batch number) from its staged
@@ -746,6 +900,15 @@ class EpochReadahead:
                 h.release()
         if self._exec is not None:
             self._exec.shutdown(wait=True)
+        # Drop every hot-cache entry this engine warmed (consumed
+        # windows already evicted themselves; this sweeps the planned-
+        # ahead tail of a cancelled epoch, returning its quota bytes).
+        for w in sorted(self._warmed):
+            try:
+                self.store.cache_evict(self._wid_base + w)
+            except Exception:  # noqa: BLE001 — advisory teardown sweep
+                pass
+        self._warmed.clear()
 
     def __enter__(self):
         return self

@@ -180,12 +180,13 @@ def _sync_state(store: DDStore, group, *, joiner: bool,
         if tiered:
             # Serve straight from page cache (the rejoin half of
             # spill_to_disk): the mapping is pinned in the meta exactly
-            # like add_mmap's, and update stays refused.
+            # like add_file's cold tier, and update stays refused.
             store._native.add(name, arr, all_nrows, copy=False)
             store._meta[name] = _VarMeta(dtype, sample_shape,
                                          _row_disp(sample_shape),
                                          all_nrows, pinned=arr,
-                                         readonly=True)
+                                         readonly=True, tier="cold")
+            store._native.set_var_tier(name, 1)
         else:
             store._native.add(name, np.ascontiguousarray(arr), all_nrows,
                               copy=True)

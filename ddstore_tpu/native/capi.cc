@@ -190,6 +190,69 @@ int dds_integrity_scrub(dds_handle* h) {
   return h->store->ScrubOnce();
 }
 
+// -- tiered storage: hot-row cache + cold placement ---------------------------
+
+// Runtime hot-row cache budget (bytes; 0 disables and evicts
+// everything, < 0 keeps). Load-time equivalent:
+// DDSTORE_TIER_CACHE_BYTES.
+int dds_tier_configure(dds_handle* h, int64_t cache_bytes) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->ConfigureTierCache(cache_bytes);
+}
+
+// Record a registered variable's storage tier (0 = hot RAM/shm, 1 =
+// cold file-backed) — drives the cold_vars/cold_bytes gauges; the
+// serving legs are tier-agnostic.
+int dds_set_var_tier(dds_handle* h, const char* name, int tier) {
+  if (!h || !name) return dds::kErrInvalidArg;
+  return h->store->SetVarTier(name, tier);
+}
+
+// The recorded tier of `name`, or a negative ErrorCode.
+int dds_var_tier(dds_handle* h, const char* name) {
+  if (!h || !name) return dds::kErrInvalidArg;
+  return h->store->VarTier(name);
+}
+
+// Per-tenant placement policy for mirror fills and snapshot kept
+// copies: cold != 0 lands them file-backed under DDSTORE_TIER_COLD_DIR.
+int dds_set_tier_placement(dds_handle* h, const char* tenant, int cold) {
+  if (!h || !tenant) return dds::kErrInvalidArg;
+  return h->store->SetTierPlacement(tenant, cold);
+}
+
+// Warm the hot-row cache with `n` sorted-unique global rows of `name`
+// as window `window` (the eviction key); the fill runs detached on the
+// async pool. Advisory: disabled-cache / duplicate / over-budget calls
+// are counted no-ops. `as_tenant` (nullable) names the READING tenant
+// for the quota charge and QoS admission.
+int64_t dds_cache_prefetch(dds_handle* h, const char* name,
+                           const int64_t* rows, int64_t n,
+                           int64_t window, const char* as_tenant) {
+  if (!h || !name) return dds::kErrInvalidArg;
+  return h->store->CachePrefetch(name, rows, n, window,
+                                 as_tenant ? as_tenant : "");
+}
+
+// Evict window `window`'s cache entries (< 0: every entry), releasing
+// their tenant-quota charges. Returns the count evicted.
+int dds_cache_evict(dds_handle* h, int64_t window) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->CacheEvict(window);
+}
+
+// Tiering observability snapshot. Layout (keep in sync with binding.py
+// TIERING_STAT_KEYS): [cache_max_bytes, cache_bytes, cache_entries,
+// cold_vars, cold_bytes, cache_hits, cache_hit_bytes, cache_misses,
+// cache_miss_bytes, cache_fills, cache_fill_bytes, cache_fill_failures,
+// cache_evictions, cache_evicted_bytes, cache_over_budget,
+// cache_prefetches].
+int dds_tiering_stats(dds_handle* h, int64_t out[16]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->TieringStats(out);
+  return dds::kOk;
+}
+
 // -- tenant namespaces / quotas / snapshot epochs -----------------------------
 
 // Byte/var budget for one tenant (< 0 = unlimited). Checked-and-

@@ -201,6 +201,40 @@ Store::Store(std::unique_ptr<Transport> transport)
   integrity_on_.store(
       verify_.load(std::memory_order_relaxed) || scrub_ms > 0,
       std::memory_order_relaxed);
+  // Tiered storage: hot-row cache budget, cold-file directory and the
+  // per-tenant mirror/kept placement policy. All default OFF — the
+  // unconfigured tree is byte-identical to the pre-tiering store.
+  if (const char* env = std::getenv("DDSTORE_TIER_CACHE_BYTES")) {
+    char* end = nullptr;
+    const long long v = std::strtoll(env, &end, 10);
+    if (end != env && v >= 0) tier_cache_.Configure(v);
+  }
+  if (const char* env = std::getenv("DDSTORE_TIER_COLD_DIR"))
+    cold_dir_ = env;
+  if (const char* env = std::getenv("DDSTORE_TIER_PLACEMENT")) {
+    // "tenant=cold[,tenant=hot,...]"; a bare "cold"/"hot" entry names
+    // the DEFAULT tenant (the quota-spec parser cannot express "",
+    // and default-tenant mirrors are the common single-tenant case).
+    const std::string s(env);
+    size_t pos = 0;
+    while (pos <= s.size()) {
+      size_t next = s.find(',', pos);
+      if (next == std::string::npos) next = s.size();
+      const std::string entry = s.substr(pos, next - pos);
+      const size_t eq = entry.find('=');
+      const std::string tenant =
+          eq == std::string::npos ? "" : entry.substr(0, eq);
+      const std::string val =
+          eq == std::string::npos ? entry : entry.substr(eq + 1);
+      bool ok = !tenant.empty() || eq == std::string::npos ||
+                entry.compare(0, 1, "=") == 0;
+      for (const char c : tenant)
+        ok = ok && static_cast<unsigned char>(c) >= 0x20;
+      if (ok && (val == "cold" || val == "hot"))
+        SetTierPlacement(tenant, val == "cold" ? 1 : 0);
+      pos = next + 1;
+    }
+  }
   health_.Init(rank(), world());
   if (scrub_ms > 0) ConfigureScrub(scrub_ms);
   if (world() > 1) {
@@ -406,7 +440,14 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
       }
     }
   }
+  // Cache coherence: warmed copies of the pre-update bytes must never
+  // serve a post-update read — dropped INSIDE the exclusive section
+  // (quota charges returned after the lock; tenants_mu_ stays a leaf).
+  std::vector<std::shared_ptr<tier::Entry>> dropped;
+  if (tier_cache_.enabled()) tier_cache_.DropVar(name, &dropped);
   transport_->PublishVar(name, v.base, v.shard_bytes());
+  lock.unlock();
+  ReleaseTierQuota(dropped);
   return kOk;
 }
 
@@ -429,6 +470,13 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
   // Span root of this read: every transport/retry/failover event below
   // (including the serving rank's, via the frame tag) records under it.
   trace::ScopedOp top(rank(), trace::kClsGet, target, nbytes);
+  // Hot-row cache consult (tiered storage): a warmed range is one
+  // memcpy, local or remote owner alike. One relaxed load when off.
+  if (tier_cache_.enabled() &&
+      TierServe(name, v, target, offset, nbytes, dst)) {
+    AccountTenantRead(name, nbytes, as_tenant);
+    return top.ret(kOk);
+  }
   // The retried primary read, shared by both replication branches and
   // (as the `reread` hook) by the verify ladder.
   auto primary_read = [&]() {
@@ -490,6 +538,13 @@ struct Run {
 
 int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
                     int64_t n, const std::string& as_tenant) {
+  return GetBatchImpl(name, dst, starts, n, as_tenant,
+                      /*use_cache=*/true);
+}
+
+int Store::GetBatchImpl(const std::string& name, void* dst,
+                        const int64_t* starts, int64_t n,
+                        const std::string& as_tenant, bool use_cache) {
   if (!dst || !starts || n < 0) return kErrInvalidArg;
   if (n == 0) return kOk;
   VarInfo v;
@@ -582,6 +637,9 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
   std::vector<std::pair<const Run*, char*>> fixups;  // scratch scatter list
   int64_t spos = 0;
   int64_t local_runs = 0;
+  // One relaxed load gates the whole tier hook: the disabled tree
+  // plans, partitions and counts exactly as before.
+  const bool cache_on = use_cache && tier_cache_.enabled();
   for (const Run& r : runs) {
     char* rdst;
     if (r.direct) {
@@ -591,6 +649,12 @@ int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
       spos += r.nrows * rb;
       fixups.emplace_back(&r, rdst);
     }
+    // Hot-row cache consult, run-by-run, local AND remote legs: a
+    // warmed run is one memcpy — a cold-tier page fault or a wire
+    // round trip avoided. Misses fall through to the normal path.
+    if (cache_on &&
+        TierServe(name, v, r.target, r.offset, r.nrows * rb, rdst))
+      continue;
     if (r.target == rank()) {
       ++local_runs;
       local_ops.push_back(ReadOp{r.offset, r.nrows * rb, rdst});
@@ -743,7 +807,11 @@ int Store::FillMirror(const std::string& name, int owner,
       m.itemsize = v.itemsize;
       m.nrows = nrows;
       m.cum.assign(1, nrows);
-      m.base = static_cast<char*>(transport_->AllocShard(mname, bytes));
+      // Mirror fills honor the owning tenant's placement policy: a
+      // "cold" tenant's replica coverage lands on NVMe-backed pages
+      // instead of pinning RAM (the serving legs are unchanged — the
+      // mapping memcpys and streams like any other shard).
+      m.base = AllocPlacedShard(mname, bytes);
       if (!m.base) return kErrNoMem;
       m.owned = true;
       const VarInfo& placed =
@@ -1418,6 +1486,226 @@ void Store::IntegrityStats(int64_t out[16]) const {
   out[15] = icnt_.last_corrupt_peer.load(std::memory_order_relaxed);
 }
 
+// -- tiered storage: hot-row cache + cold placement ---------------------------
+
+int Store::ConfigureTierCache(int64_t max_bytes) {
+  if (max_bytes < 0) return kOk;
+  tier_cache_.Configure(max_bytes);
+  // Disabling evicts everything (and returns the tenant-quota
+  // charges) — a disabled cache must hold zero RAM.
+  if (max_bytes == 0) CacheEvict(-1);
+  return kOk;
+}
+
+int Store::SetVarTier(const std::string& name, int tier) {
+  if (tier < 0 || tier > 1) return kErrInvalidArg;
+  std::unique_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  if (it == vars_.end()) return kErrNotFound;
+  it->second.tier = tier;
+  return kOk;
+}
+
+int Store::VarTier(const std::string& name) const {
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  auto it = vars_.find(name);
+  return it == vars_.end() ? kErrNotFound : it->second.tier;
+}
+
+int Store::SetTierPlacement(const std::string& tenant, int cold) {
+  std::lock_guard<std::mutex> lock(cold_mu_);
+  tier_placement_[tenant] = cold ? 1 : 0;
+  return kOk;
+}
+
+bool Store::ColdPlacementFor(const std::string& name) const {
+  if (cold_dir_.empty()) return false;
+  const std::string tenant = TenantOfVarName(name);
+  std::lock_guard<std::mutex> lock(cold_mu_);
+  if (tier_placement_.empty()) return false;  // policy never configured
+  auto it = tier_placement_.find(tenant);
+  return it != tier_placement_.end() && it->second == 1;
+}
+
+char* Store::AllocPlacedShard(const std::string& name, int64_t bytes) {
+  if (ColdPlacementFor(name)) {
+    void* base = tier::ColdAlloc(cold_dir_, bytes);
+    if (base) {
+      {
+        std::lock_guard<std::mutex> lock(cold_mu_);
+        cold_maps_[base] = bytes;
+      }
+      cold_placed_bytes_.fetch_add(bytes, std::memory_order_relaxed);
+      return static_cast<char*>(base);
+    }
+    // Cold allocation failed (full/absent dir): degrade to RAM — a
+    // placement preference must never fail a mirror fill or an
+    // Update's copy-on-publish.
+  }
+  return static_cast<char*>(transport_->AllocShard(name, bytes));
+}
+
+void Store::FreeOwnedShard(const std::string& name, void* base) {
+  if (base) {
+    int64_t len = -1;
+    {
+      std::lock_guard<std::mutex> lock(cold_mu_);
+      auto it = cold_maps_.find(base);
+      if (it != cold_maps_.end()) {
+        len = it->second;
+        cold_maps_.erase(it);
+      }
+    }
+    if (len >= 0) {
+      cold_placed_bytes_.fetch_sub(len, std::memory_order_relaxed);
+      tier::ColdFree(base, len);
+      return;
+    }
+  }
+  transport_->FreeShard(name, base);
+}
+
+bool Store::TenantReserveBytes(const std::string& tenant, int64_t bytes,
+                               bool* charged) {
+  *charged = false;
+  if (tenant.empty() &&
+      !track_default_tenant_.load(std::memory_order_relaxed))
+    return true;  // untracked: nothing to charge (zero-lock default)
+  std::lock_guard<std::mutex> lock(tenants_mu_);
+  TenantState& t = tenants_[tenant];
+  if (t.quota_bytes >= 0 && t.bytes + bytes > t.quota_bytes)
+    return false;  // advisory refusal: NOT a quota_rejection (nothing
+                   // was admitted or refused registration)
+  t.bytes += bytes;
+  *charged = true;
+  return true;
+}
+
+void Store::TenantReleaseBytes(const std::string& tenant, int64_t bytes) {
+  std::lock_guard<std::mutex> lock(tenants_mu_);
+  auto it = tenants_.find(tenant);
+  if (it == tenants_.end()) return;
+  it->second.bytes -= bytes;
+  if (it->second.bytes < 0) it->second.bytes = 0;
+}
+
+void Store::ReleaseTierQuota(
+    const std::vector<std::shared_ptr<tier::Entry>>& gone) {
+  for (const auto& e : gone)
+    if (e->quota_charged > 0 && e->quota_live.exchange(false))
+      TenantReleaseBytes(e->tenant, e->quota_charged);
+}
+
+bool Store::TierServe(const std::string& name, const VarInfo& v,
+                      int target, int64_t offset, int64_t nbytes,
+                      void* dst) {
+  const int64_t rb = v.row_bytes();
+  if (rb <= 0 || nbytes <= 0 || offset % rb || nbytes % rb)
+    return false;  // non-row-aligned: unservable, not a miss class
+  if (target < 0 || target >= static_cast<int>(v.cum.size()))
+    return false;
+  const int64_t shard_begin = target == 0 ? 0 : v.cum[target - 1];
+  const int64_t row0 = shard_begin + offset / rb;
+  if (!tier_cache_.ServeRun(name, row0, nbytes / rb, rb,
+                            static_cast<char*>(dst)))
+    return false;
+  trace::Ev(trace::kCacheHit, rank(), row0, nbytes, target);
+  return true;
+}
+
+int Store::CachePrefetch(const std::string& name, const int64_t* rows,
+                         int64_t n, int64_t window,
+                         const std::string& as_tenant) {
+  if (!tier_cache_.enabled()) return kOk;  // advisory no-op when off
+  if (n == 0) return kOk;  // nothing to warm
+  if (!rows || n < 0) return kErrInvalidArg;
+  VarInfo v;
+  if (!GetVarInfo(name, &v)) return kErrNotFound;
+  const int64_t rb = v.row_bytes();
+  if (rb <= 0) return kErrInvalidArg;
+  tier_cache_.counters().prefetches.fetch_add(
+      1, std::memory_order_relaxed);
+  const std::string tenant =
+      as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
+  bool charged = false;
+  // Quota-charged cache: the warmed bytes count against the READING
+  // tenant's byte budget until eviction. An over-budget tenant's
+  // prefetch is skipped (advisory — reads stay correct through the
+  // cold path), never classified kErrQuota.
+  if (!TenantReserveBytes(tenant, n * rb, &charged)) {
+    tier_cache_.counters().over_budget.fetch_add(
+        1, std::memory_order_relaxed);
+    return kOk;
+  }
+  // The entry enters the map fully armed (tenant + quota charge): an
+  // eviction racing this prefetch must release the charge through the
+  // entry it removed, never leak it.
+  auto e = tier_cache_.Begin(name, rows, n, rb, window, tenant,
+                             charged ? n * rb : 0);
+  if (!e) {  // duplicate warm or cache over budget (counted inside)
+    if (charged) TenantReleaseBytes(tenant, n * rb);
+    return kOk;
+  }
+  // Detached fill on the async pool: admission-gated and tenant-
+  // accounted like any window read, re-entering the batched-read
+  // machinery with the cache BYPASSED (a fill must not serve itself).
+  // The ticket self-releases at completion, so a peer death mid
+  // cold-fill leaves AsyncPending() == 0 and the failed slot freed
+  // exactly once (shared_ptr) — the ASan stress block's contract.
+  SubmitAsync(
+      tenant,
+      [this, name, e]() {
+        int rc = GetBatchImpl(name, e->buf.get(), e->rows.data(),
+                              static_cast<int64_t>(e->rows.size()),
+                              e->tenant, /*use_cache=*/false);
+        FinishCacheFill(e, rc);
+        return rc;
+      },
+      /*detached=*/true);
+  return kOk;
+}
+
+void Store::FinishCacheFill(const std::shared_ptr<tier::Entry>& e,
+                            int rc) {
+  tier_cache_.Commit(e, rc == kOk);
+  if (rc != kOk && e->quota_charged > 0 &&
+      e->quota_live.exchange(false))
+    TenantReleaseBytes(e->tenant, e->quota_charged);
+  trace::Ev(trace::kCacheFill, rank(), e->window,
+            rc == kOk ? e->bytes() : 0, rc);
+}
+
+int Store::CacheEvict(int64_t window) {
+  std::vector<std::shared_ptr<tier::Entry>> gone;
+  const int n = tier_cache_.Evict(window, &gone);
+  ReleaseTierQuota(gone);
+  // Traced OUTSIDE the cache's leaf mutex (the emit-site discipline).
+  for (const auto& e : gone)
+    trace::Ev(trace::kCacheEvict, rank(), e->window, e->bytes(), 0);
+  return n;
+}
+
+void Store::TieringStats(int64_t out[16]) const {
+  int64_t c[13];
+  tier_cache_.Stats(c);
+  out[0] = tier_cache_.max_bytes();
+  out[1] = c[11];  // charged cache bytes (gauge)
+  out[2] = c[12];  // live entries (gauge)
+  int64_t cold_vars = 0, cold_bytes = 0;
+  {
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    for (const auto& kv : vars_)
+      if (kv.second.tier == 1) {
+        ++cold_vars;
+        cold_bytes += kv.second.shard_bytes();
+      }
+  }
+  out[3] = cold_vars;
+  out[4] =
+      cold_bytes + cold_placed_bytes_.load(std::memory_order_relaxed);
+  for (int i = 0; i < 11; ++i) out[5 + i] = c[i];
+}
+
 // -- tenant quotas, shares, accounting ----------------------------------------
 
 int Store::SetTenantQuota(const std::string& tenant, int64_t max_bytes,
@@ -1677,7 +1965,9 @@ void Store::MaybeKeepLocked(const std::string& name, const VarInfo& v) {
   k.nrows = v.nrows;
   k.cum.assign(1, v.nrows);  // local-only: kept copies are addressed by
                              // byte offset, exactly like mirrors
-  k.base = static_cast<char*>(transport_->AllocShard(kname, bytes));
+  // Kept copies honor the placement policy too: a snapshot epoch over
+  // a "cold" tenant's data keeps its pinned versions on the cold tier.
+  k.base = AllocPlacedShard(kname, bytes);
   if (!k.base) return;  // no RAM for the copy: snapshot readers of this
                         // shard degrade to current bytes, never a
                         // failed Update
@@ -1700,7 +1990,7 @@ void Store::FreeKeepsLocked(const std::string& name) {
       ++it;
       continue;
     }
-    if (it->second.owned) transport_->FreeShard(it->first, it->second.base);
+    if (it->second.owned) FreeOwnedShard(it->first, it->second.base);
     kept_bytes_ -= it->second.shard_bytes();
     --kept_versions_;
     it = vars_.erase(it);
@@ -1758,7 +2048,7 @@ int Store::UnpinSnapshot(int64_t snap_id) {
     // memcpy, so the free waits it out; the next read resolves to the
     // primary.
     if (kit->second.owned)
-      transport_->FreeShard(kit->first, kit->second.base);
+      FreeOwnedShard(kit->first, kit->second.base);
     kept_bytes_ -= kit->second.shard_bytes();
     --kept_versions_;
     vars_.erase(kit);
@@ -2044,7 +2334,7 @@ void Store::PumpAsyncLocked() {
 }
 
 int64_t Store::SubmitAsync(const std::string& tenant,
-                           std::function<int()> fn) {
+                           std::function<int()> fn, bool detached) {
   auto st = std::make_shared<AsyncState>();
   int64_t ticket;
   {
@@ -2063,7 +2353,8 @@ int64_t Store::SubmitAsync(const std::string& tenant,
     }
     ticket = next_ticket_++;
     async_[ticket] = st;
-    auto task = [this, tenant, fn = std::move(fn), st]() {
+    auto task = [this, tenant, fn = std::move(fn), st, ticket,
+                 detached]() {
       int rc = fn();
       {
         std::lock_guard<std::mutex> lock(st->mu);
@@ -2080,6 +2371,10 @@ int64_t Store::SubmitAsync(const std::string& tenant,
       auto rit = async_tenant_running_.find(tenant);
       if (rit != async_tenant_running_.end() && rit->second > 0)
         --rit->second;
+      // A detached ticket (cache fill) self-releases: no caller will
+      // ever wait on it, and a leaked ticket would read as a pending
+      // async leak. Idempotent vs DrainAsync's wholesale clear.
+      if (detached) async_.erase(ticket);
       PumpAsyncLocked();
     };
     if (async_running_ < AsyncWidth() &&
@@ -2184,11 +2479,20 @@ int Store::ReadRuns(const std::string& name, char* dst,
   trace::ScopedOp top(rank(), trace::kClsReadRuns, -1, total_bytes);
   std::vector<ReadOp> local_ops;
   std::map<int, std::vector<ReadOp>> by_peer;
+  // Cache fills never come through here (they ride GetBatchImpl with
+  // use_cache=false), so the window fast path always consults: this
+  // is exactly where a readahead-warmed window's read becomes an
+  // in-RAM gather.
+  const bool cache_on = tier_cache_.enabled();
   for (int64_t i = 0; i < nruns; ++i) {
     if (targets[i] < 0 || targets[i] >= world() || nbytes[i] < 0 ||
         dst_off[i] < 0)
       return top.ret(kErrInvalidArg);
     ReadOp op{src_off[i], nbytes[i], dst + dst_off[i]};
+    if (cache_on &&
+        TierServe(name, v, static_cast<int>(targets[i]), src_off[i],
+                  nbytes[i], op.dst))
+      continue;
     if (targets[i] == rank()) {
       local_ops.push_back(op);
     } else {
@@ -2370,9 +2674,13 @@ int Store::Rebind(const std::string& name, void* base) {
   // over TCP, where this exclusive lock serializes it), publish the new
   // backing only once it is in place.
   transport_->UnpublishVar(name);
-  if (v.owned) transport_->FreeShard(name, v.base);
+  if (v.owned) FreeOwnedShard(name, v.base);
   v.base = static_cast<char*>(base);
   v.owned = false;
+  // Cache coherence: the elastic-recovery path rebinds ROLLED-BACK
+  // bytes — a warmed copy of the pre-rollback shard must not serve.
+  std::vector<std::shared_ptr<tier::Entry>> tier_dropped;
+  if (tier_cache_.enabled()) tier_cache_.DropVar(name, &tier_dropped);
   if (integrity_on_.load(std::memory_order_relaxed) && v.base) {
     // Recompute unconditionally: the spill path swaps in identical
     // bytes (same sums), but the elastic-recovery path rebinds a
@@ -2402,19 +2710,26 @@ int Store::Rebind(const std::string& name, void* base) {
     icnt_.sums_rows.fetch_add(v.nrows, std::memory_order_relaxed);
   }
   transport_->PublishVar(name, v.base, v.shard_bytes());
+  lock.unlock();
+  ReleaseTierQuota(tier_dropped);
   return kOk;
 }
 
 int Store::FreeVar(const std::string& name) {
   int64_t reserved_bytes = -1;
+  std::vector<std::shared_ptr<tier::Entry>> tier_dropped;
   {
     std::unique_lock<std::shared_mutex> lock(mu_);
     auto it = vars_.find(name);
     if (it == vars_.end()) return kErrNotFound;
     reserved_bytes = it->second.quota_reserved;
     transport_->UnpublishVar(name);
-    if (it->second.owned) transport_->FreeShard(name, it->second.base);
+    if (it->second.owned) FreeOwnedShard(name, it->second.base);
     vars_.erase(it);
+    // Warmed cache entries die with the variable (free is collective;
+    // a re-add under the same name restarts at a fresh generation and
+    // must never be served the old one's bytes).
+    if (tier_cache_.enabled()) tier_cache_.DropVar(name, &tier_dropped);
     // Kept snapshot versions of the variable die with it (their pins
     // now resolve to nothing; UnpinSnapshot tolerates the absence).
     FreeKeepsLocked(name);
@@ -2433,13 +2748,14 @@ int Store::FreeVar(const std::string& name) {
         if (mit == vars_.end()) continue;
         transport_->UnpublishVar(mit->first);
         if (mit->second.owned)
-          transport_->FreeShard(mit->first, mit->second.base);
+          FreeOwnedShard(mit->first, mit->second.base);
         vars_.erase(mit);
       }
     }
   }
   // Quota returned AFTER the registry lock drops (leaf-lock discipline);
   // exactly what registration reserved, never a post-hoc recomputation.
+  ReleaseTierQuota(tier_dropped);
   if (reserved_bytes >= 0)
     TenantRelease(TenantOfVarName(name), reserved_bytes);
   // Integrity tables die with the variable — own table AND every
@@ -2452,11 +2768,12 @@ int Store::FreeVar(const std::string& name) {
 
 int Store::FreeAll() {
   std::vector<std::pair<std::string, int64_t>> released;
+  std::vector<std::shared_ptr<tier::Entry>> tier_dropped;
   {
     std::unique_lock<std::shared_mutex> lock(mu_);
     for (auto& kv : vars_) {
       transport_->UnpublishVar(kv.first);
-      if (kv.second.owned) transport_->FreeShard(kv.first, kv.second.base);
+      if (kv.second.owned) FreeOwnedShard(kv.first, kv.second.base);
       if (kv.second.quota_reserved >= 0)
         released.emplace_back(TenantOfVarName(kv.first),
                               kv.second.quota_reserved);
@@ -2465,7 +2782,15 @@ int Store::FreeAll() {
     snap_pins_.clear();
     kept_versions_ = 0;
     kept_bytes_ = 0;
+    // The whole cache dies with the registry, INSIDE the exclusive
+    // section (FreeVar's discipline): an entry warmed in the gap
+    // between an outside-the-lock evict and the registry clear would
+    // survive and serve the dead generation's bytes to a re-added
+    // variable of the same name. Quota charges returned after the
+    // lock (tenants_mu_ stays a leaf).
+    tier_cache_.Evict(-1, &tier_dropped);
   }
+  ReleaseTierQuota(tier_dropped);
   for (const auto& r : released) TenantRelease(r.first, r.second);
   {
     std::lock_guard<std::mutex> lock(sums_mu_);

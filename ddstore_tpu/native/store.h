@@ -37,6 +37,7 @@
 #include "health.h"
 #include "integrity.h"
 #include "thread_annotations.h"
+#include "tier.h"
 
 namespace dds {
 
@@ -115,6 +116,11 @@ struct VarInfo {
   // the default tenant between add and free never releases budget
   // that was never reserved.
   int64_t quota_reserved = -1;
+  // Storage tier of the shard's backing: 0 = hot (RAM/shm), 1 = cold
+  // (file-backed mmap, NVMe page cache). Set by the Python add_file /
+  // spill paths (SetVarTier) — the registry serves both identically;
+  // the tier only drives the cold gauges and the placement policy.
+  int tier = 0;
 
   int64_t row_bytes() const { return disp * itemsize; }
   int64_t total_rows() const { return cum.empty() ? 0 : cum.back(); }
@@ -601,6 +607,50 @@ class Store {
   // scrub_repaired, last_corrupt_peer].
   void IntegrityStats(int64_t out[16]) const;
 
+  // -- tiered storage: hot-row cache + cold placement ----------------------
+  //
+  // DDSTORE_TIER_CACHE_BYTES > 0 arms a bounded RAM cache of row
+  // ranges (tier::HotRowCache). The readahead engine warms it with
+  // upcoming windows' row lists (CachePrefetch — an async, detached,
+  // quota-charged fill through the normal batched-read path) and every
+  // top-level read (Get/GetBatch/ReadRuns) consults it run-by-run, so
+  // a warmed window's delivery is an in-RAM gather while the NEXT
+  // window's cold rows stream in behind it. Disabled (the default) the
+  // whole tree is byte-, error-code- and seeded-fault-counter-
+  // identical to the pre-tiering store. DDSTORE_TIER_COLD_DIR +
+  // DDSTORE_TIER_PLACEMENT additionally let mirror fills and snapshot
+  // kept copies LAND COLD (file-backed mmap) per tenant policy — a
+  // replica chain or snapshot epoch no longer has to pin RAM.
+
+  // Runtime cache budget (bytes; 0 disables and evicts, < 0 keeps).
+  int ConfigureTierCache(int64_t max_bytes);
+  // Record the tier of a registered variable's backing (0 hot, 1
+  // cold); drives the cold_vars/cold_bytes gauges only.
+  int SetVarTier(const std::string& name, int tier);
+  // The recorded tier, or a negative ErrorCode.
+  int VarTier(const std::string& name) const;
+  // Placement policy for `tenant`'s mirror fills and kept copies:
+  // 1 = cold (file-backed under DDSTORE_TIER_COLD_DIR), 0 = hot.
+  int SetTierPlacement(const std::string& tenant, int cold);
+  // Warm the cache with `n` sorted-unique global rows of `name` as
+  // window `window` (the eviction key). Advisory: over-budget /
+  // duplicate / disabled-cache calls return kOk and do nothing. The
+  // fill runs detached on the async pool (admission-gated, tenant-
+  // accounted, ticket auto-released on completion) and is charged
+  // against the reading tenant's byte quota until eviction.
+  int CachePrefetch(const std::string& name, const int64_t* rows,
+                    int64_t n, int64_t window,
+                    const std::string& as_tenant = std::string());
+  // Evict window `window`'s entries (< 0: every entry), releasing
+  // their quota charges. Returns the entry count evicted.
+  int CacheEvict(int64_t window);
+  // Tiering observability. Layout (keep in sync with binding.py
+  // TIERING_STAT_KEYS): [cache_max_bytes, cache_bytes, cache_entries,
+  // cold_vars, cold_bytes, hits, hit_bytes, misses, miss_bytes,
+  // fills, fill_bytes, fill_failures, evictions, evicted_bytes,
+  // over_budget, prefetches].
+  void TieringStats(int64_t out[16]) const;
+
   // -- tenant quotas, shares, accounting ----------------------------------
   //
   // Per-tenant admission control: a byte/var budget checked atomically
@@ -750,6 +800,41 @@ class Store {
   int AddInternal(const std::string& name, const void* buf, int64_t nrows,
                   int64_t disp, int64_t itemsize, const int64_t* all_nrows,
                   bool copy, bool zero_fill);
+
+  // -- tiering internals ---------------------------------------------------
+
+  // The real GetBatch body. `use_cache` = false is the cache FILL's
+  // entry (a fill re-consulting the cache would serve itself).
+  int GetBatchImpl(const std::string& name, void* dst,
+                   const int64_t* starts, int64_t n,
+                   const std::string& as_tenant, bool use_cache);
+  // Try to serve one planned run ([offset, offset+nbytes) of
+  // `target`'s shard of `name`) from the hot cache. Only row-aligned
+  // runs are servable; a hit is one memcpy + a trace event.
+  bool TierServe(const std::string& name, const VarInfo& v, int target,
+                 int64_t offset, int64_t nbytes, void* dst);
+  // Fill completion: commit/remove the entry, release its tenant-quota
+  // charge on failure, emit the kCacheFill trace event.
+  void FinishCacheFill(const std::shared_ptr<tier::Entry>& e, int rc);
+  // Release evicted/dropped entries' tenant-quota charges (each
+  // exactly once via the entry's quota_live exchange).
+  void ReleaseTierQuota(
+      const std::vector<std::shared_ptr<tier::Entry>>& gone);
+  // Bytes-only tenant-quota charge for cache entries (no var count,
+  // no kErrQuota classification — prefetch is advisory). True when
+  // charged OR the tenant is untracked (nothing to charge).
+  bool TenantReserveBytes(const std::string& tenant, int64_t bytes,
+                          bool* charged);
+  void TenantReleaseBytes(const std::string& tenant, int64_t bytes);
+  // Cold placement: true when `name`'s owning tenant's policy says
+  // mirror/kept allocations land on the cold tier (and a cold dir is
+  // configured).
+  bool ColdPlacementFor(const std::string& name) const;
+  // Allocate a shard backing honoring the placement policy: a cold
+  // file mapping when policy says so (tracked in cold_maps_), else
+  // the transport's AllocShard. FreeOwnedShard is the matching free.
+  char* AllocPlacedShard(const std::string& name, int64_t bytes);
+  void FreeOwnedShard(const std::string& name, void* base);
 
   // Bounded transient-retry wrapper around one transport call (Get's
   // single read, GetBatch/ReadRuns' ReadVMulti). No-op passthrough when
@@ -920,11 +1005,16 @@ class Store {
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
   // Acquired before the CMA registry's mutex (Add/Update/Rebind/Free
-  // publish shard mappings while holding the exclusive lock) and before
+  // publish shard mappings while holding the exclusive lock), before
   // the integrity table mutex (Update/Rebind refresh sums under the
-  // exclusive lock).
+  // exclusive lock), before the cold-map mutex (kept-copy/mirror
+  // allocations run under the exclusive lock) and before the hot-row
+  // cache's mutex (Update/Rebind/FreeVar drop stale cache entries
+  // inside their exclusive sections so a post-write read can never be
+  // served pre-write bytes).
   mutable std::shared_mutex mu_
-      DDS_ACQUIRED_BEFORE(CmaRegistry::mu_, sums_mu_);
+      DDS_ACQUIRED_BEFORE(CmaRegistry::mu_, sums_mu_, cold_mu_,
+                          HotRowCache::mu_);
   std::map<std::string, VarInfo> vars_ DDS_GUARDED_BY(mu_);
   std::unique_ptr<Transport> transport_;
   bool fence_active_ DDS_GUARDED_BY(mu_) = false;
@@ -961,9 +1051,14 @@ class Store {
                const std::vector<int64_t>& dst_off,
                const std::vector<int64_t>& nbytes,
                const std::string& as_tenant = std::string());
-  // Shared issue half of GetBatchAsync/ReadRunsAsync. `tenant` rides
-  // the admission gate (QoS shares) and the per-tenant ledger.
-  int64_t SubmitAsync(const std::string& tenant, std::function<int()> fn);
+  // Shared issue half of GetBatchAsync/ReadRunsAsync (and the cache
+  // fills). `tenant` rides the admission gate (QoS shares) and the
+  // per-tenant ledger. `detached` tickets erase THEMSELVES from the
+  // ticket map at completion (no caller will ever wait/release them
+  // — the cache fill's contract: a failed fill leaves
+  // AsyncPending() == 0 without anyone reaping).
+  int64_t SubmitAsync(const std::string& tenant, std::function<int()> fn,
+                      bool detached = false);
   // Admit the next deferred async reads while running < width. Caller
   // holds async_mu_.
   void PumpAsyncLocked() DDS_REQUIRES(async_mu_);
@@ -1003,6 +1098,25 @@ class Store {
       DDS_GUARDED_BY(async_mu_);
   std::map<std::string, int64_t> async_tenant_deferred_
       DDS_GUARDED_BY(async_mu_);
+
+  // -- tiered-storage state ------------------------------------------------
+  // Hot-row cache (off unless DDSTORE_TIER_CACHE_BYTES > 0; one
+  // relaxed load guards every hook). Entries are filled through the
+  // async pool, so DrainAsync (which runs first in ~Store) finishes
+  // every fill before the cache member is destroyed.
+  tier::HotRowCache tier_cache_;
+  // Cold placement: directory for file-backed mirror/kept allocations
+  // (DDSTORE_TIER_COLD_DIR, resolved at construction) and the
+  // per-tenant policy map (DDSTORE_TIER_PLACEMENT / runtime setter).
+  // cold_maps_ records every live cold mapping's length so
+  // FreeOwnedShard can route frees (munmap vs transport FreeShard);
+  // the mmap/ftruncate syscalls run OUTSIDE cold_mu_ — only the map
+  // bookkeeping holds it.
+  std::string cold_dir_;
+  mutable std::mutex cold_mu_ DDS_NO_BLOCKING;
+  std::map<void*, int64_t> cold_maps_ DDS_GUARDED_BY(cold_mu_);
+  std::map<std::string, int> tier_placement_ DDS_GUARDED_BY(cold_mu_);
+  std::atomic<int64_t> cold_placed_bytes_{0};
 
   // -- integrity state -----------------------------------------------------
   // Reader-side verification on (DDSTORE_VERIFY=1 / ConfigureIntegrity).

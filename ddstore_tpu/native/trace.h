@@ -81,6 +81,11 @@ enum EventType : uint16_t {
   kBarrierDone = 24,   // collective completed: a=seq, b=tag, c=rounds
   kBarrierAbort = 25,  // collective aborted: a=seq, b=round,
                        // c=suspected-dead peer (-1 = plain timeout)
+  kCacheFill = 26,     // hot-row cache fill completed: a=window id,
+                       // b=bytes filled (0 on failure), c=rc
+  kCacheHit = 27,      // run served from the hot cache: a=first
+                       // global row, b=bytes, c=owner rank
+  kCacheEvict = 28,    // entry evicted: a=window id, b=bytes, c=0
 };
 
 // Op classes for kOpBegin/kOpEnd `a`. Keep in sync with binding.py

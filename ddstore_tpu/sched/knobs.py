@@ -22,7 +22,7 @@ from typing import Dict, Optional
 
 #: The jointly planned knobs (see :mod:`ddstore_tpu.sched.planner`).
 PLANNED_KNOBS = ("route_bulk", "route_scatter", "lanes_bulk",
-                 "lanes_scatter", "depth", "width")
+                 "lanes_scatter", "depth", "width", "prefetch")
 
 
 @dataclass(frozen=True)
@@ -61,6 +61,11 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_TCP_LANES_AUTOTUNE", "pin",
        ("lanes_bulk", "lanes_scatter"),
        "0 pins striping at the full pool size"),
+    _k("DDSTORE_TIER_PREFETCH_DEPTH", "pin", ("prefetch",),
+       "hot-cache warm-ahead depth (windows planned + prefetched "
+       "beyond the one being issued); unset = planned from the cache "
+       "budget and the measured hot-hit/cold-miss cells; 0 disables "
+       "warming"),
     # -- configuration (never planned) -----------------------------------
     _k("DDSTORE_BACKEND", "config", desc="local/tcp backend select"),
     _k("DDSTORE_BARRIER_TIMEOUT_S", "config"),
@@ -142,6 +147,22 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
        desc="per-tenant registration budgets 't=bytes[:vars],...' "
             "(< 0 = unlimited); an over-budget add/init is refused "
             "with ERR_QUOTA (-11), a distinct non-fatal class"),
+    _k("DDSTORE_TIER_CACHE_BYTES", "config",
+       desc="hot-row cache byte budget (default 0 = off, the whole "
+            "tiering tree inert and byte-identical); size it to hold "
+            "(ring depth + prefetch depth + 1) readahead windows of "
+            "the active variables"),
+    _k("DDSTORE_TIER_COLD_DIR", "config",
+       desc="directory for cold-tier file-backed allocations (mirror "
+            "fills / snapshot kept copies placed 'cold'); files are "
+            "created unlinked, so crashes cannot leak disk"),
+    _k("DDSTORE_TIER_PLACEMENT", "config",
+       desc="per-tenant mirror/kept-copy placement "
+            "'tenant=cold|hot,...' (a bare 'cold' names the default "
+            "tenant); default hot — cold requires "
+            "DDSTORE_TIER_COLD_DIR"),
+    _k("DDSTORE_TIERED_PHASE_TIMEOUT_S", "config",
+       desc="bench tiered-phase subprocess cap, default 300"),
     _k("DDSTORE_TENANT_SHARES", "config",
        desc="per-tenant QoS weights 't=weight,...': async admission "
             "is share-split (each tenant runs at most max(1, width * "
@@ -225,6 +246,12 @@ def pinned_knobs(env: Optional[dict] = None) -> Dict[str, object]:
     if v:
         try:
             pins["depth"] = int(v)
+        except ValueError:
+            pass
+    v = e.get("DDSTORE_TIER_PREFETCH_DEPTH", "").strip()
+    if v:
+        try:
+            pins["prefetch"] = int(v)
         except ValueError:
             pass
     return pins

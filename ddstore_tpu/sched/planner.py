@@ -217,8 +217,11 @@ class Scheduler:
         peers = max(1, store.world - 1) if store is not None else 1
         self.model = CostModel(cores, peers)
         # Host-side substrate cells: delivered window-fetch throughput
-        # keyed by the depth it ran at (source "window").
+        # keyed by the depth it ran at (source "window"), plus the
+        # per-tier cells (source "tier": hot-hit vs cold-miss fetch
+        # legs) the prefetch planner reads.
         self.samples = SampleSet()
+        self._tier_prefetch: Optional[int] = None
         self._mu = threading.Lock()
         self._replan_mu = threading.Lock()
         self._plan = Plan(pins=pinned_knobs())
@@ -254,6 +257,49 @@ class Scheduler:
         depth = self._plan.depth or self.requested_depth or 1
         with self._mu:
             self.samples.fold("window", 0, depth, nbytes, secs, cold)
+
+    def observe_tier(self, nbytes: int, secs: float, warmed: bool,
+                     cold: bool = False) -> None:
+        """Fold one window fetch into the PER-TIER read cells: knob 1 =
+        hot-hit (the window was cache-warmed before issue, its fetch is
+        an in-RAM gather), knob 0 = cold-miss (unwarmed — NVMe page
+        faults / wire reads). Same warm-window hygiene as every other
+        cell; ``planned_prefetch`` reads these to decide whether
+        warming ahead is paying."""
+        with self._mu:
+            self.samples.fold("tier", 0, 1 if warmed else 0, nbytes,
+                              secs, cold)
+
+    def planned_prefetch(self, requested: int, window_bytes: int,
+                         cache_bytes: int, depth: int) -> int:
+        """The hot-cache warm-ahead depth (windows planned+prefetched
+        beyond the one being issued) the readahead engine should run:
+        the DDSTORE_TIER_PREFETCH_DEPTH pin wins outright; otherwise
+        ``requested`` clamped to what the cache budget can actually
+        hold (consumed-window entries evict as the pipeline advances,
+        so ~``depth + prefetch`` windows are live at once), dropped to
+        1 when the measured hot-hit cell shows no gain over cold-miss
+        (warming that doesn't pay should not burn RAM and fill
+        traffic)."""
+        pins = pinned_knobs()
+        if isinstance(pins.get("prefetch"), int):
+            return max(0, int(pins["prefetch"]))
+        if cache_bytes <= 0 or window_bytes <= 0:
+            return 0
+        fit = int(cache_bytes // window_bytes) - max(1, int(depth))
+        p = max(0, min(int(requested), fit))
+        if not self.enabled:
+            return p
+        with self._mu:
+            hot = self.samples.cell("tier", 0, 1)
+            cold = self.samples.cell("tier", 0, 0)
+            if (hot is not None and cold is not None
+                    and hot.n >= WARM_MIN_SAMPLES
+                    and cold.n >= WARM_MIN_SAMPLES
+                    and hot.ewma <= cold.ewma):
+                p = min(p, 1)
+            self._tier_prefetch = p
+        return p
 
     # -- planning ----------------------------------------------------------
 
@@ -502,6 +548,18 @@ class Scheduler:
                 "window", 0, plan.depth or self.requested_depth)
             if cell is not None:
                 measured = round(cell.ewma / 1e9, 3)
+            # Per-tier read cells (tiered storage): the measured
+            # hot-hit vs cold-miss window-fetch EWMAs and the warm-
+            # ahead depth last planned from them.
+            hot = self.samples.cell("tier", 0, 1)
+            cold = self.samples.cell("tier", 0, 0)
+            tier = {
+                "hot_hit_gbps": round(hot.ewma / 1e9, 3)
+                if hot is not None and hot.ewma else 0.0,
+                "cold_miss_gbps": round(cold.ewma / 1e9, 3)
+                if cold is not None and cold.ewma else 0.0,
+                "prefetch": self._tier_prefetch,
+            }
             return {
                 "enabled": self.enabled,
                 "engaged": plan.engaged,
@@ -519,4 +577,5 @@ class Scheduler:
                 "cores": self.model.cores,
                 "peers": self.model.peers,
                 "suspected_peers": suspected,
+                "tier": tier,
             }

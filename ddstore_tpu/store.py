@@ -163,12 +163,12 @@ def _my_ifaces() -> list:
 
 class _VarMeta:
     __slots__ = ("dtype", "sample_shape", "disp", "all_nrows", "pinned",
-                 "readonly")
+                 "readonly", "tier")
 
     def __init__(self, dtype: np.dtype, sample_shape: Tuple[int, ...],
                  disp: int, all_nrows: Sequence[int],
                  pinned: Optional[np.ndarray] = None,
-                 readonly: bool = False):
+                 readonly: bool = False, tier: str = "hot"):
         self.dtype = dtype
         self.sample_shape = sample_shape
         self.disp = disp
@@ -179,6 +179,10 @@ class _VarMeta:
         # True for read-only mmap backings: `update` must refuse rather
         # than memcpy into unwritable pages (SIGSEGV).
         self.readonly = readonly
+        # Storage tier of the backing ("hot" = RAM/shm, "cold" =
+        # file-backed mmap over NVMe page cache). Mirrored natively
+        # (set_var_tier) for the cold_vars/cold_bytes gauges.
+        self.tier = tier
 
 
 class DDStore:
@@ -408,8 +412,12 @@ class DDStore:
         ``update``, pyddstore.pyx:115-131 — bounds-checked here)."""
         m = self._require(name)
         if m.readonly:
-            raise DDStoreError(-1, f"update({name}): variable is backed by "
-                                   "a read-only mapping")
+            raise DDStoreError(
+                -1, f"update({name}): refused — the shard is a "
+                    f"read-only {m.tier}-tier file-backed mapping "
+                    f"(registered via add_file/add_mmap/spill_to_disk "
+                    f"with copy=False); re-register with mode='r+' or "
+                    f"tier='hot' to keep update() usable")
         arr = np.ascontiguousarray(arr, dtype=m.dtype)
         if tuple(arr.shape[1:]) != m.sample_shape:
             raise ValueError(
@@ -565,24 +573,58 @@ class DDStore:
     # doubles it at registration (ddstore.hpp:43-49); this is the
     # capability BASELINE.md's billion-edge / host↔NVMe config asks for.
 
-    def add_mmap(self, name: str, path: str, dtype,
-                 sample_shape: Tuple[int, ...], mode: str = "r") -> None:
-        """Register a file-backed shard (collective). ``nrows`` is inferred
-        from the file size; ``mode="r+"`` keeps ``update`` usable."""
+    def add_file(self, name: str, path: str, dtype,
+                 sample_shape: Tuple[int, ...], tier: str = "cold",
+                 mode: str = "r") -> None:
+        """Register a file-backed shard (collective) — the first-class
+        cold-tier entry point. ``nrows`` is inferred from the file
+        size.
+
+        ``tier="cold"`` (the default) registers an ``np.memmap`` with
+        ``copy=False``: the store serves one-sided reads straight out
+        of the OS page cache, so the kernel tiers hot rows in RAM and
+        cold rows on NVMe — the servable dataset per node scales with
+        the NVMe/RAM ratio, not RAM. Every serving leg (local memcpy,
+        /dev/shm CMA, TCP iovec streaming), replication mirrors,
+        integrity sums and tenant quotas work on a cold shard
+        unchanged; pair it with ``DDSTORE_TIER_CACHE_BYTES`` so the
+        readahead planner's window row lists prefetch upcoming cold
+        rows into the RAM hot-row cache. ``mode="r"`` shards refuse
+        ``update()`` (the error names the tier); ``mode="r+"`` keeps
+        it usable. ``tier="hot"`` loads the file INTO RAM instead
+        (a store-owned copy — the pre-tiering behavior for data that
+        fits)."""
+        if tier not in ("cold", "hot"):
+            raise ValueError(f"add_file({name}): tier must be 'cold' or "
+                             f"'hot', got {tier!r}")
         dtype = np.dtype(dtype)
         disp = _row_disp(tuple(sample_shape))
         row_bytes = disp * dtype.itemsize
         size = os.path.getsize(path)
         if size % row_bytes:
-            raise ValueError(f"add_mmap({name}): {path} size {size} is not "
+            raise ValueError(f"add_file({name}): {path} size {size} is not "
                              f"a multiple of row bytes {row_bytes}")
         nrows = size // row_bytes
+        if tier == "hot":
+            arr = np.fromfile(path, dtype=dtype).reshape(
+                (nrows,) + tuple(sample_shape))
+            self.add(name, arr, copy=True)
+            return
         if nrows:
             arr = np.memmap(path, dtype=dtype, mode=mode,
                             shape=(nrows,) + tuple(sample_shape))
         else:  # a rank may own zero rows; mmap of an empty file is invalid
             arr = np.empty((0,) + tuple(sample_shape), dtype)
         self.add(name, arr, copy=False, readonly=(mode == "r"))
+        self._meta[name].tier = "cold"
+        self._native.set_var_tier(self._wname(name), 1)
+
+    def add_mmap(self, name: str, path: str, dtype,
+                 sample_shape: Tuple[int, ...], mode: str = "r") -> None:
+        """Register a file-backed shard (collective) — the historical
+        alias of :meth:`add_file` with ``tier="cold"``."""
+        self.add_file(name, path, dtype, sample_shape, tier="cold",
+                      mode=mode)
 
     def spill_to_disk(self, name: str, directory: str,
                       chunk_rows: int = 65536) -> str:
@@ -611,6 +653,8 @@ class DDStore:
         self._native.rebind(self._wname(name), arr)
         m.pinned = arr  # keep the mapping alive; old pin (if any) drops
         m.readonly = True
+        m.tier = "cold"
+        self._native.set_var_tier(self._wname(name), 1)
         # Collective completion: once any rank returns, every rank's swap
         # is done (mirrors add()'s barrier guarantee).
         self.barrier()
@@ -1014,6 +1058,59 @@ class DDStore:
         row-aligned re-pull) run inline and are counted in
         :meth:`integrity_stats`."""
         return self._native.integrity_scrub()
+
+    # -- tiered storage: hot-row cache + cold placement --------------------
+
+    def tier_configure(self, cache_bytes: int = -1) -> None:
+        """Runtime hot-row cache budget (bytes; 0 disables and evicts
+        everything, < 0 keeps; load-time:
+        ``DDSTORE_TIER_CACHE_BYTES``). The readahead engine warms the
+        cache with upcoming windows' row lists automatically whenever
+        the budget is non-zero — size it to hold (ring depth +
+        prefetch depth + 1) windows of the active variables."""
+        self._native.tier_configure(cache_bytes)
+
+    def set_tier_placement(self, tenant: str, cold: bool) -> None:
+        """Placement policy for ``tenant``'s replication mirrors and
+        snapshot kept copies: ``cold`` lands them as file-backed
+        mappings under ``DDSTORE_TIER_COLD_DIR`` (NVMe page cache,
+        evictable) instead of pinned RAM — a busy trainer pins RAM, an
+        eval snapshot reader tolerates NVMe latency. Load-time:
+        ``DDSTORE_TIER_PLACEMENT``."""
+        self._check_tenant_label(tenant)
+        self._native.set_tier_placement(tenant, cold)
+
+    def var_tier(self, name: str) -> str:
+        """The registered storage tier of ``name``: ``"hot"`` (RAM) or
+        ``"cold"`` (file-backed)."""
+        return "cold" if self._native.var_tier(self._rname(name)) else \
+            "hot"
+
+    def cache_prefetch(self, name: str, rows, window: int = 0) -> None:
+        """Warm the hot-row cache with sorted-unique global ``rows`` of
+        ``name`` under eviction key ``window`` (advisory; the fill runs
+        detached on the native async pool and is charged against the
+        reading tenant's byte quota until eviction). The readahead
+        engine calls this with its upcoming windows' row lists — a free
+        lookahead, the plan exists before the window is issued."""
+        self._require(name)
+        self._native.cache_prefetch(self._rname(name), rows,
+                                    window=window,
+                                    tenant=self._read_tenant())
+
+    def cache_evict(self, window: int = -1) -> int:
+        """Evict window ``window``'s hot-cache entries (< 0: every
+        entry); returns the count evicted. The readahead engine evicts
+        each window as its last batch is consumed."""
+        return self._native.cache_evict(window)
+
+    def tiering_stats(self) -> dict:
+        """Tiering counters (:data:`binding.TIERING_STAT_KEYS`): cache
+        budget/occupancy gauges, cold-tier registrations, and the
+        monotone hit/miss/fill/evict ledger. Monotone except the
+        gauges; ``DeviceLoader.metrics`` wires this in as
+        ``summary()["tiering"]``."""
+        return self._native.tiering_stats()
 
     def check_health(self) -> list:
         """Poll the liveness view and fire the peer listeners exactly

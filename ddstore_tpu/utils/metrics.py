@@ -210,6 +210,13 @@ class PipelineMetrics:
         self._integrity_source: Optional[Callable[[], Dict]] = None
         self._integrity_begin: Optional[Dict] = None
         self._integrity_end: Optional[Dict] = None
+        # Tiering source (DDStore.tiering_stats): snapshotted at epoch
+        # boundaries — summary()["tiering"] is how an epoch record
+        # proves "the hot cache served N% of the window bytes, the
+        # cold tier held the rest" on its own.
+        self._tiering_source: Optional[Callable[[], Dict]] = None
+        self._tiering_begin: Optional[Dict] = None
+        self._tiering_end: Optional[Dict] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -442,6 +449,51 @@ class PipelineMetrics:
                     self._integrity_begin.get(k, 0)))
         return out
 
+    #: gauge keys of the tiering source (reported raw, never delta'd —
+    #: keep in sync with binding.TIERING_GAUGE_KEYS).
+    TIERING_GAUGES = ("cache_max_bytes", "cache_bytes", "cache_entries",
+                      "cold_vars", "cold_bytes")
+
+    def set_tiering_source(self,
+                           source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning cumulative tiering
+        counters (``DDStore.tiering_stats``). Snapshotted at epoch
+        boundaries; ``summary()["tiering"]`` reports per-epoch deltas
+        (gauges raw) plus the derived ``cache_hit_rate`` — hit bytes
+        over consulted bytes, the number the tiered bench gates on."""
+        self._tiering_source = source
+
+    def _snap_tiering(self) -> Optional[Dict]:
+        if self._tiering_source is None:
+            return None
+        try:
+            return dict(self._tiering_source())
+        except Exception:
+            return None
+
+    def tiering_summary(self) -> Dict:
+        """Per-epoch tiering view: counter deltas + the live gauges +
+        the epoch's byte-weighted cache hit rate."""
+        out: Dict = {}
+        if self._tiering_begin is None:
+            return out
+        end = self._tiering_end if self._tiering_end is not None \
+            else self._snap_tiering()
+        if end is None:
+            return out
+        for k in end:
+            if k in self.TIERING_GAUGES:
+                out[k] = int(end[k])
+            else:
+                out[k] = max(0, int(end[k]) - int(
+                    self._tiering_begin.get(k, 0)))
+        consulted = out.get("cache_hit_bytes", 0) + \
+            out.get("cache_miss_bytes", 0)
+        out["cache_hit_rate"] = round(
+            out.get("cache_hit_bytes", 0) / consulted, 4) \
+            if consulted else 0.0
+        return out
+
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
             -> None:
         """Attach a zero-arg callable returning the cost-model
@@ -585,6 +637,8 @@ class PipelineMetrics:
         self._trace_end = None
         self._integrity_begin = self._snap_integrity()
         self._integrity_end = None
+        self._tiering_begin = self._snap_tiering()
+        self._tiering_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -607,6 +661,7 @@ class PipelineMetrics:
         self._tenant_end = self._snap_tenants()
         self._trace_end = self._snap_trace()
         self._integrity_end = self._snap_integrity()
+        self._tiering_end = self._snap_tiering()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -683,6 +738,17 @@ class PipelineMetrics:
                    or any(v for k, v in ig.items()
                           if k not in self.INTEGRITY_GAUGES)):
             out["integrity"] = ig
+        tg = self.tiering_summary()
+        # Included while the hot cache is armed or any cold-tier
+        # variable is registered (an all-zero hit row is the "nothing
+        # warmed this epoch" result the tiered A/B reads) or if any
+        # counter moved; untiered epochs stay byte-identical.
+        if tg and (tg.get("cache_max_bytes", 0) > 0
+                   or tg.get("cold_vars", 0) > 0
+                   or any(v for k, v in tg.items()
+                          if k not in self.TIERING_GAUGES
+                          and k != "cache_hit_rate")):
+            out["tiering"] = tg
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself

@@ -16,11 +16,103 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_file_backed_var_refuses_update_naming_tier(tmp_path):
+    """ISSUE 13 satellite: a file-backed (copy=False) variable refuses
+    ``update()`` with an error NAMING the tier — the contract rejoin's
+    mmap restore relies on (a replacement must never silently
+    re-materialize, and a caller must learn WHY update is refused)."""
+    from ddstore_tpu import DDStore, DDStoreError
+
+    data = np.arange(160, dtype=np.float64).reshape(20, 8)
+    path = tmp_path / "s.bin"
+    data.tofile(path)
+    with DDStore(backend="local") as s:
+        s.add_file("v", str(path), np.float64, (8,), tier="cold")
+        assert s.var_tier("v") == "cold"
+        with pytest.raises(DDStoreError, match="cold-tier"):
+            s.update("v", np.zeros((1, 8)))
+        # The spill path records the same tier.
+        s.add("w", np.ones((4, 2), np.float32))
+        s.spill_to_disk("w", str(tmp_path / "spill"))
+        assert s.var_tier("w") == "cold"
+        with pytest.raises(DDStoreError, match="cold-tier"):
+            s.update("w", np.zeros((1, 2), np.float32))
+
+
+def test_mmap_shards_serve_identical_over_tcp_and_cma(tmp_path):
+    """ISSUE 13 satellite: mmap-backed shards registered through the
+    new tier API (the exact shape a rejoin restore produces:
+    np.memmap + copy=False) serve byte-identical over BOTH wire legs —
+    forced TCP and the same-host CMA fast path (borrowed shards ride
+    process_vm_readv)."""
+    from ddstore_tpu import DDStore, DDStoreError, ThreadGroup
+
+    world, rows, dim = 2, 64, 16
+
+    def run(cma_on):
+        env = {"DDSTORE_CMA": "1" if cma_on else "0"}
+        backup = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        name = f"tier-{tmp_path.name}-{cma_on}"
+        out = {}
+        errs = []
+        try:
+            def body(rank):
+                try:
+                    g = ThreadGroup(name, rank, world)
+                    p = tmp_path / f"sh{cma_on}{rank}.bin"
+                    rng = np.random.default_rng(50 + rank)
+                    rng.standard_normal((rows, dim)).astype(
+                        np.float64).tofile(p)
+                    with DDStore(g, backend="tcp") as s:
+                        s.add_file("v", str(p), np.float64, (dim,),
+                                   tier="cold")
+                        s.barrier()
+                        if rank == 0:
+                            got = s.get_batch(
+                                "v", np.arange(world * rows))
+                            out["got"] = got.copy()
+                            out["cma_ops"] = s.cma_ops
+                            with pytest.raises(DDStoreError,
+                                               match="cold-tier"):
+                                s.update("v", np.zeros((1, dim)))
+                        s.barrier()
+                except Exception as e:  # pragma: no cover
+                    errs.append((rank, e))
+
+            ts = [threading.Thread(target=body, args=(r,))
+                  for r in range(world)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs, errs
+        finally:
+            for k, v in backup.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return out
+
+    oracle = np.concatenate([
+        np.random.default_rng(50 + r).standard_normal(
+            (rows, dim)).astype(np.float64) for r in range(world)])
+    tcp = run(cma_on=False)
+    cma = run(cma_on=True)
+    np.testing.assert_array_equal(tcp["got"], oracle)
+    np.testing.assert_array_equal(cma["got"], oracle)
+    assert tcp["cma_ops"] == 0
+    assert cma["cma_ops"] > 0, "CMA leg never engaged"
 
 _WORKER = r"""
 import os, sys, time

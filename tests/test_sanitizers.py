@@ -358,6 +358,84 @@ ts = [threading.Thread(target=intg_worker, args=(r, errs))
 [t.start() for t in ts]
 [t.join() for t in ts]
 assert not errs, errs
+
+# Tiered-storage paths under the sanitizer (ISSUE 13 satellite):
+# (a) hot-cache EVICTION RACING CONCURRENT BATCH READS — the reader's
+# memcpy runs outside the cache lock from its own entry reference, so
+# a racing evict must free the buffer exactly once, after the copy;
+# (b) a PEER DEATH MID COLD-FILL — the detached fill fails over the
+# dead wire, releases its async ticket (async_pending()==0) and frees
+# the partially-filled slot exactly once (shared_ptr), quota returned.
+os.environ["DDSTORE_REPLICATION"] = "1"
+os.environ["DDSTORE_RETRY_MAX"] = "2"
+os.environ["DDSTORE_OP_DEADLINE_S"] = "3"
+import time as _time
+TIERNAME = uuid.uuid4().hex
+ZROWS, ZDIM = 256, 1 << 10  # 4 KiB rows
+
+tier_stores = {}
+tier_ready = threading.Barrier(2)
+
+def tier_worker(rank, errs):
+    try:
+        group = ThreadGroup(TIERNAME, rank, 2)
+        s = DDStore(group, backend="tcp")
+        tier_stores[rank] = s
+        s.add("v", np.full((ZROWS, ZDIM), rank + 1, np.float32))
+        s.tier_configure(64 << 20)
+        tier_ready.wait()
+        if rank != 0:
+            return  # serves until rank 0 kills it below
+        # (a) eviction hammering while batched reads consume warm
+        # entries (byte identity asserted on every read).
+        stop = threading.Event()
+
+        def evictor():
+            while not stop.is_set():
+                s.cache_evict(-1)
+
+        ev = threading.Thread(target=evictor)
+        ev.start()
+        rng = np.random.default_rng(3)
+        try:
+            for it in range(30):
+                rows = np.sort(rng.choice(2 * ZROWS, size=64,
+                                          replace=False))
+                s.cache_prefetch("v", rows, window=it)
+                got = s.get_batch("v", rows)
+                want = (rows // ZROWS + 1).astype(np.float32)[:, None]
+                assert (got == want).all()
+        finally:
+            stop.set()
+            ev.join()
+        # (b) peer death mid cold-fill: warm rank 1's rows while its
+        # store tears down underneath the wire read.
+        rows = np.arange(ZROWS, 2 * ZROWS)
+        s.cache_prefetch("v", rows, window=10**6)
+        tier_stores[1]._native.close()
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            st = s.tiering_stats()
+            done = st["cache_fills"] + st["cache_fill_failures"]
+            if done >= st["cache_prefetches"] and \
+                    s.async_pending() == 0:
+                break
+            _time.sleep(0.02)
+        assert s.async_pending() == 0, s.async_pending()
+        s.cache_evict(-1)
+        st = s.tiering_stats()
+        assert st["cache_entries"] == 0 and st["cache_bytes"] == 0, st
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=tier_worker, args=(r, errs))
+      for r in range(2)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
+for s in tier_stores.values():
+    s._native.close()  # idempotent for the dead rank
 print("stress ok")
 """
 

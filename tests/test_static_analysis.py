@@ -79,9 +79,12 @@ class TestWholeTree:
         assert "mu" in conn.mutexes and conn.guarded["fd"] == "Conn::mu"
         # declared order edges seeded into the graph (mu_ gained the
         # integrity-table edge in ISSUE 11: Update/Rebind refresh sums
-        # under the exclusive registry lock)
+        # under the exclusive registry lock, and the cold-map + hot-row-
+        # cache edges in ISSUE 13: kept-copy/mirror placement and cache
+        # coherence drops run under the exclusive registry lock)
         assert store.acquired_before["mu_"] == ["CmaRegistry::mu_",
-                                                "sums_mu_"]
+                                                "sums_mu_", "cold_mu_",
+                                                "HotRowCache::mu_"]
         assert store.acquired_before["async_mu_"] == ["WorkerPool::mu_"]
         assert "sums_mu_" in store.no_blocking
         # the ISSUE 9 EnsureCmaPeer restructure moved the discovery
