@@ -209,6 +209,34 @@ def _load() -> ctypes.CDLL:
     lib.dds_cache_evict.argtypes = [ctypes.c_void_p, _i64]
     lib.dds_tiering_stats.restype = ctypes.c_int
     lib.dds_tiering_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_metrics_configure.restype = ctypes.c_int
+    lib.dds_metrics_configure.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dds_metrics_enabled.restype = ctypes.c_int
+    lib.dds_metrics_enabled.argtypes = [ctypes.c_void_p]
+    lib.dds_metrics_reset.restype = ctypes.c_int
+    lib.dds_metrics_reset.argtypes = [ctypes.c_void_p]
+    lib.dds_metrics_snapshot.restype = _i64
+    lib.dds_metrics_snapshot.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_void_p, _i64]
+    lib.dds_metrics_pull.restype = _i64
+    lib.dds_metrics_pull.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_void_p, _i64]
+    lib.dds_metrics_stats.restype = ctypes.c_int
+    lib.dds_metrics_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_metrics_tenants.restype = ctypes.c_int
+    lib.dds_metrics_tenants.argtypes = [ctypes.c_void_p,
+                                        ctypes.c_char_p, ctypes.c_int]
+    lib.dds_metrics_record.restype = ctypes.c_int
+    lib.dds_metrics_record.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_char_p, _i64, _i64]
+    lib.dds_slo_configure.restype = ctypes.c_int
+    lib.dds_slo_configure.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.dds_slo_evaluate.restype = _i64
+    lib.dds_slo_evaluate.argtypes = [ctypes.c_void_p, _i64p,
+                                     ctypes.c_int]
+    lib.dds_slo_stats.restype = ctypes.c_int
+    lib.dds_slo_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_trace_configure.restype = ctypes.c_int
     lib.dds_trace_configure.argtypes = [ctypes.c_int, ctypes.c_long]
     lib.dds_trace_enabled.restype = ctypes.c_int
@@ -335,7 +363,7 @@ TRACE_TYPES = {
     18: "lane_budget_rotate", 19: "flight", 20: "failover",
     21: "verify_fail", 22: "scrub", 23: "barrier", 24: "barrier_done",
     25: "barrier_abort", 26: "cache_fill", 27: "cache_hit",
-    28: "cache_evict",
+    28: "cache_evict", 29: "slo_breach",
 }
 #: name -> code view of :data:`TRACE_TYPES` (Python-side emitters).
 TRACE_TYPE_CODES = {v: k for k, v in TRACE_TYPES.items()}
@@ -347,7 +375,7 @@ TRACE_OP_CLASSES = {0: "get", 1: "get_batch", 2: "read_runs",
 #: flight-recorder trigger codes (trace.h FlightReason).
 TRACE_FLIGHT_REASONS = {1: "peer_lost", 2: "quota", 3: "window_giveup",
                         4: "suspect", 5: "manual", 6: "corrupt",
-                        7: "barrier_abort"}
+                        7: "barrier_abort", 8: "slo_breach"}
 
 #: dict keys of :func:`trace_stats`, in native layout order (keep in
 #: sync with capi dds_trace_stats / trace::Stats).
@@ -356,6 +384,46 @@ TRACE_FLIGHT_REASONS = {1: "peer_lost", 2: "quota", 3: "window_giveup",
 TRACE_STAT_KEYS = ("enabled", "ring_events", "threads", "capacity",
                    "live", "captured", "dropped", "flight_events",
                    "flight_dumps", "spans")
+
+
+# -- ddmetrics: always-on latency/bytes histograms + SLO monitor --------------
+#
+# Per-STORE (unlike the process-global trace rings): a ThreadGroup's
+# in-process ranks keep separate latency surfaces, and the cross-rank
+# pull (kOpMetrics) merges them into one cluster view. All layouts
+# mirror native/metrics_hist.h; drift breaks the snapshot format.
+
+#: log2 bucket count of each histogram (metrics_hist.h kBuckets).
+METRICS_BUCKETS = 44
+
+#: numpy layout of one snapshot cell (keep in sync with
+#: metrics_hist.h `CellRecord` — packed little-endian).
+METRICS_CELL_DTYPE = np.dtype([
+    ("cls", "<i4"), ("route", "<i4"), ("peer", "<i4"),
+    ("reserved", "<i4"), ("tenant", "S48"),
+    ("count", "<u8"), ("lat_sum_ns", "<u8"),
+    ("lat", "<u8", (METRICS_BUCKETS,)),
+    ("bytes_sum", "<u8"),
+    ("bytes", "<u8", (METRICS_BUCKETS,))])
+
+#: route decode table (metrics_hist.h Route — ordered by the
+#: span_latency attribution precedence: cma > tcp > local).
+METRICS_ROUTES = {0: "local", 1: "tcp", 2: "cma"}
+#: name -> code view (Python-side recorders / tests).
+METRICS_ROUTE_CODES = {v: k for k, v in METRICS_ROUTES.items()}
+
+#: dict keys of ``NativeStore.metrics_stats`` in native layout order
+#: (keep in sync with capi dds_metrics_stats).
+METRICS_STAT_KEYS = ("enabled", "cells", "cells_cap", "dropped_cells",
+                     "tenants", "tenant_overflow", "ops_recorded")
+
+#: dict keys of ``NativeStore.slo_stats`` in native layout order (keep
+#: in sync with capi dds_slo_stats). ``evaluations``/``breaches`` are
+#: monotone; the rest are gauges.
+SLO_STAT_KEYS = ("rules", "evaluations", "breaches", "window_ms",
+                 "last_breach_tenant_slot")
+#: the gauge subset of :data:`SLO_STAT_KEYS` (never delta'd).
+SLO_GAUGE_KEYS = ("rules", "window_ms", "last_breach_tenant_slot")
 
 
 def trace_configure(enabled: int, ring_events: int = -1) -> None:
@@ -780,6 +848,99 @@ class NativeStore:
         return {"active_snapshots": int(arr[0]),
                 "kept_versions": int(arr[1]),
                 "kept_bytes": int(arr[2])}
+
+    # -- ddmetrics: live latency histograms + SLO monitor -----------------
+
+    def metrics_configure(self, enabled: int) -> None:
+        """Flip THIS store's histograms at runtime (0/1; -1 keeps).
+        Load-time knob: ``DDSTORE_METRICS`` (default on)."""
+        _check(self._lib.dds_metrics_configure(self._h, int(enabled)),
+               "metrics_configure")
+
+    def metrics_enabled(self) -> bool:
+        return bool(self._lib.dds_metrics_enabled(self._h))
+
+    def metrics_reset(self) -> None:
+        """Zero every cell's counters (claimed keys stay interned)."""
+        _check(self._lib.dds_metrics_reset(self._h), "metrics_reset")
+
+    def _metrics_decode(self, fn, *args) -> np.ndarray:
+        need = int(self._lib.dds_metrics_snapshot(self._h, None, 0))
+        if need <= 0:
+            return np.empty(0, dtype=METRICS_CELL_DTYPE)
+        buf = ctypes.create_string_buffer(need)
+        n = int(fn(*args, buf, need))
+        if n < 0:
+            raise DDStoreError(n, "metrics snapshot/pull")
+        return np.frombuffer(buf.raw[:n],
+                             dtype=METRICS_CELL_DTYPE).copy()
+
+    def metrics_snapshot(self) -> np.ndarray:
+        """This store's live histogram cells as a structured array
+        (:data:`METRICS_CELL_DTYPE`): one row per (class, route, peer,
+        reading-tenant) with log2 latency/bytes buckets."""
+        return self._metrics_decode(self._lib.dds_metrics_snapshot,
+                                    self._h)
+
+    def metrics_pull(self, target: int) -> np.ndarray:
+        """Pull ``target``'s cells over the control plane (kOpMetrics
+        on the dedicated heartbeat connection; never a data lane).
+        Raises ``DDStoreError(ERR_PEER_LOST)`` for a detector-
+        suspected/dead peer — zero control budget burned, no giveup."""
+        return self._metrics_decode(self._lib.dds_metrics_pull,
+                                    self._h, int(target))
+
+    def metrics_stats(self) -> dict:
+        """Histogram registry counters (:data:`METRICS_STAT_KEYS`)."""
+        arr = (ctypes.c_int64 * 8)()
+        _check(self._lib.dds_metrics_stats(self._h, arr),
+               "metrics_stats")
+        return dict(zip(METRICS_STAT_KEYS,
+                        list(arr)[:len(METRICS_STAT_KEYS)]))
+
+    def metrics_tenants(self) -> list:
+        """Interned reading-tenant labels in slot order (slot 0 is the
+        default tenant ``""``)."""
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.dds_metrics_tenants(self._h, buf, 4096)
+        if n < 0:
+            raise DDStoreError(n, "metrics_tenants")
+        return buf.value.decode().split(",")
+
+    def metrics_record(self, cls: int, route: int, peer: int,
+                       tenant: str, lat_ns: int, nbytes: int) -> None:
+        """Fold one synthetic op sample into the histograms (test /
+        Python-side-op hook)."""
+        _check(self._lib.dds_metrics_record(
+            self._h, int(cls), int(route), int(peer), tenant.encode(),
+            int(lat_ns), int(nbytes)), "metrics_record")
+
+    def slo_configure(self, spec: str) -> None:
+        """Replace the tenant latency objectives
+        (``"t=p99:5ms,t2=p50:200us"``; a bare ``"p99:5ms"`` names the
+        default tenant; empty clears). Baselines reset to the current
+        histograms. Load-time knob: ``DDSTORE_TENANT_SLOS``."""
+        _check(self._lib.dds_slo_configure(self._h, spec.encode()),
+               f"slo_configure({spec!r})")
+
+    def slo_evaluate(self) -> list:
+        """Evaluate every objective over the histogram delta since the
+        last evaluation (rate-limited by ``DDSTORE_SLO_WINDOW_MS``).
+        Returns breach rows ``[tenant_slot, pct, threshold_ns,
+        measured_low_ns, window_count]`` — a breach means the
+        p-quantile's whole log2 bucket lies above the objective."""
+        cap = 64
+        arr = (ctypes.c_int64 * (cap * 6))()
+        n = int(self._lib.dds_slo_evaluate(self._h, arr, cap))
+        if n < 0:
+            raise DDStoreError(n, "slo_evaluate")
+        return [list(arr[i * 6:i * 6 + 5]) for i in range(n)]
+
+    def slo_stats(self) -> dict:
+        """SLO monitor counters (:data:`SLO_STAT_KEYS`)."""
+        arr = (ctypes.c_int64 * 8)()
+        _check(self._lib.dds_slo_stats(self._h, arr), "slo_stats")
+        return dict(zip(SLO_STAT_KEYS, list(arr)[:len(SLO_STAT_KEYS)]))
 
     # -- replication / failover / heartbeat -------------------------------
 

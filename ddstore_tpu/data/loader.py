@@ -194,6 +194,18 @@ class DeviceLoader:
             # variable is registered (inert — and absent from the
             # summary — otherwise).
             self.metrics.set_tiering_source(store.tiering_stats)
+        if store is not None and hasattr(store, "metrics_snapshot"):
+            # ddmetrics: summary()["latency"] carries this epoch's
+            # live p50/p90/p99 per (class, route, peer, tenant) from
+            # the always-on native histograms — no tracing required.
+            self.metrics.set_latency_source(store.metrics_snapshot)
+        if store is not None and hasattr(store, "slo_summary"):
+            # SLO monitor: summary()["slo"] carries the per-epoch
+            # evaluation/breach ledger; the epoch boundary below
+            # evaluates the objectives and fires the scheduler's
+            # replan trigger per breached tenant (inert with no SLOs
+            # configured).
+            self.metrics.set_slo_source(store.slo_summary)
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether
@@ -571,7 +583,31 @@ class DeviceLoader:
                 ra.close()
                 self._ra_ring = ra.ring  # reuse next epoch
             ex.shutdown(wait=True)
+            # SLO evaluation at the epoch boundary ("per epoch
+            # window"), BEFORE the metrics freeze so this epoch's
+            # summary()["slo"] carries its own verdict. A breach has
+            # already dumped the flight recorder natively; here it
+            # closes the observe->react loop by replanning the
+            # breached tenant's routes/lanes/shares.
+            self._check_slos()
             self.metrics.epoch_end()
+
+    def _check_slos(self) -> None:
+        """Evaluate the store's tenant latency SLOs over the epoch
+        window that just ended and fire one scheduler replan per
+        breached tenant (the PR 6 degradation path). Inert — one cheap
+        native call returning nothing — while no SLOs are configured;
+        never fails the epoch."""
+        store = getattr(self.dataset, "store", None)
+        if store is None or not hasattr(store, "evaluate_slos"):
+            return
+        try:
+            breaches = store.evaluate_slos()
+        except Exception:
+            return  # observability must never fail an epoch
+        if self.sched is not None:
+            for b in breaches:
+                self.sched.on_degradation(f"slo:{b['tenant']}")
 
     def __len__(self) -> int:
         n = len(self.sampler)

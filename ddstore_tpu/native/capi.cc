@@ -253,6 +253,103 @@ int dds_tiering_stats(dds_handle* h, int64_t out[16]) {
   return dds::kOk;
 }
 
+// -- ddmetrics: live latency histograms + SLO monitor -------------------------
+
+// Runtime switch for THIS store's histograms (-1 keeps; load-time knob
+// DDSTORE_METRICS, default on). Per-store, unlike the process-global
+// trace rings: a ThreadGroup's in-process ranks keep separate surfaces.
+int dds_metrics_configure(dds_handle* h, int enabled) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->ConfigureMetrics(enabled);
+}
+
+int dds_metrics_enabled(dds_handle* h) {
+  return h && h->store->MetricsEnabled() ? 1 : 0;
+}
+
+// Zero every cell's counters (claimed keys/tenants stay interned).
+int dds_metrics_reset(dds_handle* h) {
+  if (!h) return dds::kErrInvalidArg;
+  h->store->MetricsReset();
+  return dds::kOk;
+}
+
+// Serialize this store's cells as packed metrics::CellRecords
+// (binding.py METRICS_CELL_DTYPE). out == NULL returns the worst-case
+// byte size; else the bytes written.
+int64_t dds_metrics_snapshot(dds_handle* h, void* out, int64_t cap) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->MetricsSnapshot(out, cap);
+}
+
+// Pull `target`'s snapshot over the control plane (kOpMetrics on the
+// dedicated PingConn; LocalTransport reads the peer registry
+// directly). Returns bytes written, or a negative ErrorCode —
+// kErrPeerLost for a detector-suspected/dead peer (zero budget burned,
+// never a giveup).
+int64_t dds_metrics_pull(dds_handle* h, int target, void* out,
+                         int64_t cap) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  return h->store->MetricsPull(target, out, cap);
+}
+
+// Counter snapshot: [enabled, cells, cells_cap, dropped_cells,
+// tenants, tenant_overflow, ops_recorded, 0] — keep in sync with
+// binding.py METRICS_STAT_KEYS.
+int dds_metrics_stats(dds_handle* h, int64_t out[8]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->MetricsStats(out);
+  return dds::kOk;
+}
+
+// CSV of interned reading-tenant labels in slot order (the default
+// tenant is the leading empty field). Returns the length written.
+int dds_metrics_tenants(dds_handle* h, char* out, int cap) {
+  if (!h || !out || cap <= 0) return dds::kErrInvalidArg;
+  return h->store->metrics_registry().TenantNamesCsv(out, cap);
+}
+
+// Test / Python-side injection hook: fold one synthetic op sample into
+// the histograms (bucket-math units, exporter fixtures, Python-layer
+// ops that never cross the native read path). kErrInvalidArg on an
+// out-of-range class/route/peer, like every sibling entry.
+int dds_metrics_record(dds_handle* h, int cls, int route, int peer,
+                       const char* tenant, int64_t lat_ns,
+                       int64_t bytes) {
+  if (!h || lat_ns < 0 || bytes < 0) return dds::kErrInvalidArg;
+  return h->store->MetricsRecord(cls, route, peer,
+                                 tenant ? tenant : "",
+                                 static_cast<uint64_t>(lat_ns),
+                                 static_cast<uint64_t>(bytes));
+}
+
+// Replace the tenant latency objectives ("t=p99:5ms,..."; empty
+// clears; load-time knob DDSTORE_TENANT_SLOS). Baselines reset to the
+// current histograms.
+int dds_slo_configure(dds_handle* h, const char* spec) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->SetTenantSlos(spec ? spec : "");
+}
+
+// Evaluate every objective over the delta window since the last
+// evaluation (rate-limited by DDSTORE_SLO_WINDOW_MS). Breach rows of 6
+// int64s [tenant_slot, pct, threshold_ns, measured_low_ns,
+// window_count, 0] land in `out` (<= cap_rows); returns the breach
+// count. Each breach emits a kSloBreach trace event and one flight
+// dump (kReasonSloBreach).
+int64_t dds_slo_evaluate(dds_handle* h, int64_t* out, int cap_rows) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  return h->store->EvaluateSlos(out, cap_rows);
+}
+
+// [rules, evaluations, breaches, window_ms, last_breach_tenant_slot,
+// 0, 0, 0] — keep in sync with binding.py SLO_STAT_KEYS.
+int dds_slo_stats(dds_handle* h, int64_t out[8]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->SloStats(out);
+  return dds::kOk;
+}
+
 // -- tenant namespaces / quotas / snapshot epochs -----------------------------
 
 // Byte/var budget for one tenant (< 0 = unlimited). Checked-and-

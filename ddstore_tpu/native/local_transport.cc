@@ -276,6 +276,20 @@ int LocalTransport::SnapshotControl(int target, int64_t snap_id,
              : peer->UnpinSnapshot(snap_id);
 }
 
+int64_t LocalTransport::ReadMetrics(int target, void* out, int64_t cap) {
+  for (int att = 0;; ++att) {
+    if (DrawCtrlFault(target) == kOk) break;
+    if (att >= ctrl_retry_max_) return kErrTransport;
+  }
+  Store* peer = group_->member(target);
+  // Registered-then-closed is the bounded "peer is gone" signal (the
+  // in-process kill vehicle) — classified like the TCP suspect
+  // short-circuit so a cluster pull skips the corpse cleanly.
+  if (!peer)
+    return group_->AliveOrPending(target) ? kErrTransport : kErrPeerLost;
+  return peer->MetricsSnapshot(out, cap);
+}
+
 int LocalTransport::ReadV(int target, const std::string& name,
                           const ReadOp* ops, int64_t n) {
   // Peer resolution and the registry lookup happen once for the batch

@@ -115,6 +115,10 @@ class LocalTransport : public Transport {
   // bounded control-retry loop, like the TCP side).
   int SnapshotControl(int target, int64_t snap_id, bool pin,
                       const std::string& tenant) override;
+  // ddmetrics histogram pull: direct serialization out of the peer
+  // store's registry (control plane, ctrl-arm injector draws absorbed
+  // by the bounded retry like the other control ops).
+  int64_t ReadMetrics(int target, void* out, int64_t cap) override;
   // Failure-aware counting barrier: aborts kErrPeerLost when a member
   // store closed mid-wait or the store's suspect oracle declares one
   // dead; the lost rank is recorded for last_failed_peer().

@@ -235,6 +235,17 @@ Store::Store(std::unique_ptr<Transport> transport)
       pos = next + 1;
     }
   }
+  // SLO monitor: per-tenant latency objectives over the ddmetrics
+  // histograms. Default OFF (no spec = inert, not a single branch past
+  // the empty-rules check); DDSTORE_SLO_WINDOW_MS rate-limits how
+  // often EvaluateSlos actually evaluates.
+  if (const char* env = std::getenv("DDSTORE_SLO_WINDOW_MS")) {
+    char* end = nullptr;
+    const long v = std::strtol(env, &end, 10);
+    if (end != env && v > 0) slo_window_ms_ = v;
+  }
+  if (const char* env = std::getenv("DDSTORE_TENANT_SLOS"))
+    SetTenantSlos(env);
   health_.Init(rank(), world());
   if (scrub_ms > 0) ConfigureScrub(scrub_ms);
   if (world() > 1) {
@@ -470,6 +481,15 @@ int Store::Get(const std::string& name, void* dst, int64_t start,
   // Span root of this read: every transport/retry/failover event below
   // (including the serving rank's, via the frame tag) records under it.
   trace::ScopedOp top(rank(), trace::kClsGet, target, nbytes);
+  // ddmetrics: one histogram sample per op at destruction (latency,
+  // bytes, route upgraded by the transport). One relaxed load when off.
+  metrics::OpTimer mtimer(
+      &metrics_, trace::kClsGet, target,
+      metrics_.enabled()
+          ? metrics_.TenantId(as_tenant.empty() ? TenantOfVarName(name)
+                                                : as_tenant)
+          : 0,
+      static_cast<uint64_t>(nbytes));
   // Hot-row cache consult (tiered storage): a warmed range is one
   // memcpy, local or remote owner alike. One relaxed load when off.
   if (tier_cache_.enabled() &&
@@ -553,6 +573,17 @@ int Store::GetBatchImpl(const std::string& name, void* dst,
   const int64_t total = v.total_rows();
   char* out = static_cast<char*>(dst);
   trace::ScopedOp top(rank(), trace::kClsGetBatch, -1, n * rb);
+  // use_cache == false is the detached cache-FILL entry (background
+  // readahead warming, the slowest reads in the system): it must not
+  // pollute the tenant's SLO latency surface with traffic the tenant
+  // never waited on — same dilution rule as nested timers.
+  metrics::OpTimer mtimer(
+      use_cache ? &metrics_ : nullptr, trace::kClsGetBatch, -1,
+      use_cache && metrics_.enabled()
+          ? metrics_.TenantId(as_tenant.empty() ? TenantOfVarName(name)
+                                                : as_tenant)
+          : 0,
+      static_cast<uint64_t>(n * rb));
 
   // -- Plan -----------------------------------------------------------------
   // Sort (row, output slot) so source-adjacent rows coalesce regardless of
@@ -2404,15 +2435,25 @@ int64_t Store::GetBatchAsync(const std::string& name, void* dst,
   // trace exists to show); the inner GetBatch joins the same span.
   uint64_t tspan = 0;
   int64_t tbytes = 0;
-  if (trace::Enabled()) {
+  if (trace::Enabled() || metrics_.enabled()) {
     VarInfo v;
     tbytes = GetVarInfo(name, &v) ? n * v.row_bytes() : 0;
+  }
+  if (trace::Enabled()) {
     tspan = trace::NewSpan(rank());
     trace::Emit(trace::kOpBegin, tspan, rank(), trace::kClsAsyncBatch,
                 -1, tbytes);
   }
+  // ddmetrics async bracket: the sample's latency is ISSUE ->
+  // completion (queueing included — the number a reader's SLO sees),
+  // so t0 is captured here and carried into the pool body's timer.
+  const uint64_t mq0 =
+      metrics_.enabled() ? metrics::OpTimer::NowNs() : 0;
+  const int mtid = metrics_.enabled() ? metrics_.TenantId(tenant) : 0;
   return SubmitAsync(tenant, [this, name, dst, tenant, tspan, tbytes,
-                              idx = std::move(idx)]() {
+                              mq0, mtid, idx = std::move(idx)]() {
+    metrics::OpTimer mtimer(&metrics_, trace::kClsAsyncBatch, -1, mtid,
+                            static_cast<uint64_t>(tbytes), mq0);
     trace::ScopedSpan sp(tspan);
     int rc = GetBatch(name, dst, idx.data(),
                       static_cast<int64_t>(idx.size()), tenant);
@@ -2442,16 +2483,25 @@ int64_t Store::ReadRunsAsync(const std::string& name, void* dst,
   // tags the execution leg as kClsReadRuns under the same span.
   uint64_t tspan = 0;
   int64_t total = 0;
-  if (trace::Enabled()) {
+  if (trace::Enabled() || metrics_.enabled())
     for (int64_t i = 0; i < nruns; ++i) total += nbytes[i];
+  if (trace::Enabled()) {
     tspan = trace::NewSpan(rank());
     trace::Emit(trace::kOpBegin, tspan, rank(), trace::kClsAsyncBatch,
                 -1, total);
   }
+  // Issue-time ddmetrics bracket, like GetBatchAsync: issue ->
+  // completion latency is THE sample (the inner ReadRuns timer is
+  // inert under it — one op, one sample).
+  const uint64_t mq0 =
+      metrics_.enabled() ? metrics::OpTimer::NowNs() : 0;
+  const int mtid = metrics_.enabled() ? metrics_.TenantId(tenant) : 0;
   return SubmitAsync(tenant,
-                     [this, name, dst, tenant, tspan, total,
+                     [this, name, dst, tenant, tspan, total, mq0, mtid,
                       t = std::move(t), so = std::move(so),
                       dof = std::move(dof), nb = std::move(nb)]() {
+    metrics::OpTimer mtimer(&metrics_, trace::kClsAsyncBatch, -1, mtid,
+                            static_cast<uint64_t>(total), mq0);
     trace::ScopedSpan sp(tspan);
     int rc = ReadRuns(name, static_cast<char*>(dst), t, so, dof, nb,
                       tenant);
@@ -2477,6 +2527,13 @@ int Store::ReadRuns(const std::string& name, char* dst,
   // thread); begin→end here is the execution leg, and a surfaced
   // kErrPeerLost triggers the flight recorder from the dtor.
   trace::ScopedOp top(rank(), trace::kClsReadRuns, -1, total_bytes);
+  metrics::OpTimer mtimer(
+      &metrics_, trace::kClsReadRuns, -1,
+      metrics_.enabled()
+          ? metrics_.TenantId(as_tenant.empty() ? TenantOfVarName(name)
+                                                : as_tenant)
+          : 0,
+      static_cast<uint64_t>(total_bytes));
   std::vector<ReadOp> local_ops;
   std::map<int, std::vector<ReadOp>> by_peer;
   // Cache fills never come through here (they ride GetBatchImpl with
@@ -2861,6 +2918,201 @@ bool Store::GetVarInfo(const std::string& name, VarInfo* out) const {
   if (it == vars_.end()) return false;
   *out = it->second;  // copies metadata; base pointer stays valid until free
   return true;
+}
+
+// -- ddmetrics: cross-rank pull + SLO monitor ---------------------------------
+
+int64_t Store::MetricsPull(int target, void* out, int64_t cap) {
+  if (target < 0 || target >= world() || !out || cap < 0)
+    return kErrInvalidArg;
+  if (target == rank()) return metrics_.Snapshot(out, cap);
+  // Detector short-circuit: a suspected peer costs ZERO control budget
+  // and never counts a giveup — a cluster latency view must assemble
+  // around a corpse, not stall on it (the caller records the hole).
+  if (PeerSuspected(target)) return kErrPeerLost;
+  return transport_->ReadMetrics(target, out, cap);
+}
+
+int Store::MetricsRecord(int cls, int route, int peer,
+                         const std::string& tenant, uint64_t lat_ns,
+                         uint64_t bytes) {
+  // Loud validation like every sibling entry: a silently dropped
+  // sample reads as an empty snapshot with no pointer to the bad
+  // argument, and an unchecked peer would wrap in the 24-bit key
+  // field and decode as a garbage rank.
+  if (cls < 0 || cls >= metrics::kNumClasses || route < 0 ||
+      route >= metrics::kNumRoutes || peer < -1 ||
+      peer >= (1 << 23))
+    return kErrInvalidArg;
+  if (!metrics_.enabled()) return kOk;
+  metrics_.Record(cls, route, peer, metrics_.TenantId(tenant), lat_ns,
+                  bytes);
+  return kOk;
+}
+
+namespace {
+// One SLO objective "p99:5ms" -> (99, 5'000'000 ns). Units ns/us/ms/s;
+// the resulting threshold must be >= 1 ns (a zero objective would read
+// every op as a breach). False on anything malformed.
+bool ParseSloObjective(const std::string& v, int* pct, uint64_t* ns) {
+  if (v.size() < 4 || (v[0] != 'p' && v[0] != 'P')) return false;
+  char* end = nullptr;
+  const long p = std::strtol(v.c_str() + 1, &end, 10);
+  if (p <= 0 || p > 100 || !end || *end != ':') return false;
+  const char* num = end + 1;
+  char* end2 = nullptr;
+  const double x = std::strtod(num, &end2);
+  if (end2 == num || !(x > 0)) return false;
+  const std::string unit(end2);
+  double scale = 0;
+  if (unit == "ns") scale = 1.0;
+  else if (unit == "us") scale = 1e3;
+  else if (unit == "ms") scale = 1e6;
+  else if (unit == "s") scale = 1e9;
+  else return false;
+  const double t = x * scale;
+  if (!(t >= 1.0) || t > 9e18) return false;
+  *pct = static_cast<int>(p);
+  *ns = static_cast<uint64_t>(t);
+  return true;
+}
+}  // namespace
+
+int Store::SetTenantSlos(const std::string& spec) {
+  std::vector<SloRule> rules;
+  bool any_entry = false;
+  size_t pos = 0;
+  while (pos <= spec.size()) {
+    size_t next = spec.find(',', pos);
+    if (next == std::string::npos) next = spec.size();
+    const std::string entry = spec.substr(pos, next - pos);
+    pos = next + 1;
+    if (entry.empty()) continue;
+    any_entry = true;
+    const size_t eq = entry.find('=');
+    // A bare "p99:5ms" names the default tenant (like the tier
+    // placement spec: "t=" cannot express "").
+    const std::string tenant =
+        eq == std::string::npos ? "" : entry.substr(0, eq);
+    const std::string obj =
+        eq == std::string::npos ? entry : entry.substr(eq + 1);
+    bool ok = true;
+    for (const char c : tenant)
+      ok = ok && static_cast<unsigned char>(c) >= 0x20;
+    SloRule r;
+    ok = ok && ParseSloObjective(obj, &r.pct, &r.threshold_ns);
+    if (!ok) continue;  // malformed entries skipped, like every spec
+    r.tenant = tenant;
+    r.tenant_id = metrics_.TenantId(tenant);
+    // An uninternable label (24-slot table full: TenantId folded it
+    // into slot 0) must NOT silently monitor the DEFAULT tenant's
+    // aggregate in the requested tenant's name — skip the rule, so a
+    // spec reduced to nothing surfaces kErrInvalidArg below.
+    if (!tenant.empty() && r.tenant_id == 0) continue;
+    // Baseline = NOW: the first window judges only traffic after the
+    // configure, never the store's whole history.
+    metrics_.TenantLatHist(r.tenant_id, r.base_hist, &r.base_count);
+    rules.push_back(std::move(r));
+  }
+  if (any_entry && rules.empty()) return kErrInvalidArg;
+  std::lock_guard<std::mutex> lock(slo_mu_);
+  slo_rules_ = std::move(rules);
+  slo_last_eval_ns_ = 0;
+  return kOk;
+}
+
+int Store::EvaluateSlos(int64_t* out, int cap_rows) {
+  if (!out || cap_rows < 0) return kErrInvalidArg;
+  struct Breach {
+    int tenant_id;
+    int pct;
+    uint64_t thr, low, cnt;
+  };
+  std::vector<Breach> breaches;
+  {
+    std::lock_guard<std::mutex> lock(slo_mu_);
+    if (slo_rules_.empty()) return 0;  // default-off: inert
+    const uint64_t now = metrics::OpTimer::NowNs();
+    if (slo_window_ms_ > 0 && slo_last_eval_ns_ != 0 &&
+        now - slo_last_eval_ns_ <
+            static_cast<uint64_t>(slo_window_ms_) * 1000000ull)
+      return 0;  // inside the window: keep the running baseline
+    slo_last_eval_ns_ = now;
+    ++slo_evals_;
+    for (SloRule& r : slo_rules_) {
+      uint64_t cur[metrics::kBuckets];
+      uint64_t cnt = 0;
+      metrics_.TenantLatHist(r.tenant_id, cur, &cnt);
+      uint64_t n = 0;
+      uint64_t delta[metrics::kBuckets];
+      for (int b = 0; b < metrics::kBuckets; ++b) {
+        // Counters are monotone EXCEPT across a MetricsReset (public
+        // API): a post-reset aggregate below the baseline must read
+        // as "the window restarted at zero", never as a wrapped
+        // ~2^64-count window that fires a garbage breach.
+        delta[b] = cur[b] >= r.base_hist[b] ? cur[b] - r.base_hist[b]
+                                            : cur[b];
+        n += delta[b];
+        r.base_hist[b] = cur[b];
+      }
+      r.base_count = cnt;
+      if (n == 0) continue;  // idle tenant: no verdict either way
+      // p-quantile bucket: smallest b whose cumulative count reaches
+      // ceil(pct/100 * n).
+      const uint64_t want = (n * static_cast<uint64_t>(r.pct) + 99) / 100;
+      uint64_t cum = 0;
+      int qb = metrics::kBuckets - 1;
+      for (int b = 0; b < metrics::kBuckets; ++b) {
+        cum += delta[b];
+        if (cum >= want) {
+          qb = b;
+          break;
+        }
+      }
+      // Provable breach only: the quantile's WHOLE log2 bucket lies at
+      // or above the objective — a bucket straddling the threshold is
+      // indeterminate and must not fire (no false breaches from
+      // bucketing).
+      const uint64_t low = metrics::BucketLow(qb);
+      if (low >= r.threshold_ns) {
+        breaches.push_back(
+            Breach{r.tenant_id, r.pct, r.threshold_ns, low, n});
+        ++slo_breaches_;
+        slo_last_breach_tenant_ = r.tenant_id;
+      }
+    }
+  }
+  // Trace emission AFTER slo_mu_ drops (no emit under a DDS_NO_BLOCKING
+  // mutex — the ddtrace discipline since PR 10).
+  int rows = 0;
+  for (const Breach& b : breaches) {
+    trace::Ev(trace::kSloBreach, rank(), b.tenant_id, b.pct,
+              static_cast<int64_t>(b.low));
+    // The flight recorder IS the point: the breach postmortem (which
+    // ops, which peers, which retries) is in the rings right now.
+    trace::Flight(trace::kReasonSloBreach, rank());
+    if (rows < cap_rows) {
+      int64_t* row = out + static_cast<int64_t>(rows) * 6;
+      row[0] = b.tenant_id;
+      row[1] = b.pct;
+      row[2] = static_cast<int64_t>(b.thr);
+      row[3] = static_cast<int64_t>(b.low);
+      row[4] = static_cast<int64_t>(b.cnt);
+      row[5] = 0;
+      ++rows;
+    }
+  }
+  return rows;
+}
+
+void Store::SloStats(int64_t out[8]) const {
+  for (int i = 0; i < 8; ++i) out[i] = 0;
+  std::lock_guard<std::mutex> lock(slo_mu_);
+  out[0] = static_cast<int64_t>(slo_rules_.size());
+  out[1] = slo_evals_;
+  out[2] = slo_breaches_;
+  out[3] = slo_window_ms_;
+  out[4] = slo_last_breach_tenant_;
 }
 
 }  // namespace dds

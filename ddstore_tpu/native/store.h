@@ -36,6 +36,7 @@
 #include "fault.h"
 #include "health.h"
 #include "integrity.h"
+#include "metrics_hist.h"
 #include "thread_annotations.h"
 #include "tier.h"
 
@@ -321,6 +322,20 @@ class Transport {
     (void)count;
     (void)seq;
     (void)sums;
+    return kErrTransport;
+  }
+
+  // ddmetrics control op: pull `target`'s live histogram snapshot
+  // (packed metrics::CellRecords) into `out`. Rides the same dedicated
+  // control channel as Ping/ReadVarSeq/ReadRowSums — never a data
+  // lane, never a DATA-plane fault-injector draw (the ctrl arm
+  // injects server-side and the bounded control-retry ladder absorbs
+  // it, like every other request/response control op). Returns the
+  // bytes written or a negative ErrorCode. Default: unsupported.
+  virtual int64_t ReadMetrics(int target, void* out, int64_t cap) {
+    (void)target;
+    (void)out;
+    (void)cap;
     return kErrTransport;
   }
 
@@ -650,6 +665,68 @@ class Store {
   // fills, fill_bytes, fill_failures, evictions, evicted_bytes,
   // over_budget, prefetches].
   void TieringStats(int64_t out[16]) const;
+
+  // -- ddmetrics: live latency histograms + SLO monitor ---------------------
+  //
+  // Always-on (DDSTORE_METRICS, default 1) log2-bucketed latency and
+  // bytes histograms per (op class, route, peer, reading tenant),
+  // updated at op end with a few relaxed atomic increments — live
+  // p50/p90/p99 without tracing (metrics_hist.h). MetricsPull merges
+  // in any peer's view over the control plane (kOpMetrics on the
+  // dedicated PingConn), so one rank can assemble the CLUSTER latency
+  // surface. The SLO monitor evaluates per-tenant latency objectives
+  // (DDSTORE_TENANT_SLOS / SetTenantSlos) over per-window deltas of
+  // these histograms: a breach emits a kSloBreach trace event, dumps
+  // the flight recorder (kReasonSloBreach), and the Python layer
+  // fires the scheduler's replan trigger. With no SLOs configured the
+  // monitor is INERT — byte-, error-code- and seeded-fault-counter-
+  // identical (it reads counters, never the data path).
+
+  metrics::Registry& metrics_registry() { return metrics_; }
+  // Runtime switch (-1 keeps); DDSTORE_METRICS is the load-time knob.
+  int ConfigureMetrics(int enabled) { return metrics_.Configure(enabled); }
+  bool MetricsEnabled() const { return metrics_.enabled(); }
+  void MetricsReset() { metrics_.Reset(); }
+  // Serialize THIS store's cells (metrics::CellRecord packed array).
+  // out == nullptr returns the worst-case byte size.
+  int64_t MetricsSnapshot(void* out, int64_t cap) const {
+    return metrics_.Snapshot(out, cap);
+  }
+  // Pull `target`'s snapshot over the control plane. target == rank()
+  // serves locally; a detector-suspected peer short-circuits to
+  // kErrPeerLost with zero control budget burned (never a giveup —
+  // cluster views must assemble around a corpse, not stall on it).
+  int64_t MetricsPull(int target, void* out, int64_t cap);
+  // Test / Python-side injection hook (bucket-math units, synthetic
+  // exporter fixtures). Interns `tenant` on first sight;
+  // kErrInvalidArg on an out-of-range class/route/peer.
+  int MetricsRecord(int cls, int route, int peer,
+                    const std::string& tenant, uint64_t lat_ns,
+                    uint64_t bytes);
+  void MetricsStats(int64_t out[metrics::kNumStats]) const {
+    metrics_.Stats(out);
+  }
+
+  // Replace the tenant latency objectives: "t=p99:5ms,t2=p50:200us"
+  // (a bare "p99:5ms" entry names the default tenant; units
+  // ns/us/ms/s; one entry per (tenant, percentile)). Baselines reset
+  // to the current histograms, so the first window starts clean.
+  // Empty spec clears. kErrInvalidArg when nothing parseable remains
+  // of a non-empty spec.
+  int SetTenantSlos(const std::string& spec);
+  // Evaluate every objective over the histogram delta since the last
+  // evaluation. Rate-limited by DDSTORE_SLO_WINDOW_MS (a call inside
+  // the window returns 0 rows and keeps the running window intact).
+  // Breaches are written as rows of 6 int64s [tenant_slot, pct,
+  // threshold_ns, measured_low_ns, window_count, 0] (bounded by
+  // cap_rows); a breach is declared only when the p-quantile's WHOLE
+  // log2 bucket lies above the objective — provable, never a
+  // bucketing artifact. Each breach emits kSloBreach and one flight
+  // dump (kReasonSloBreach). Returns the breach row count.
+  int EvaluateSlos(int64_t* out, int cap_rows);
+  // [rules, evaluations, breaches, window_ms, last_breach_tenant_slot,
+  // 0, 0, 0] — keep in sync with binding.py SLO_STAT_KEYS.
+  void SloStats(int64_t out[8]) const;
 
   // -- tenant quotas, shares, accounting ----------------------------------
   //
@@ -1016,6 +1093,14 @@ class Store {
       DDS_ACQUIRED_BEFORE(CmaRegistry::mu_, sums_mu_, cold_mu_,
                           HotRowCache::mu_);
   std::map<std::string, VarInfo> vars_ DDS_GUARDED_BY(mu_);
+  // ddmetrics histogram registry (metrics_hist.h): per-store by design
+  // — a ThreadGroup's in-process ranks must not merge their latency
+  // surfaces the way the process-global trace rings do. Declared
+  // BEFORE transport_ like vars_/mu_ for the same reason: the TCP
+  // transport's serving threads read it (the kOpMetrics serve), so it
+  // must be destroyed AFTER ~Transport joins them (reverse member
+  // order) — an ASan-caught teardown race otherwise.
+  metrics::Registry metrics_;
   std::unique_ptr<Transport> transport_;
   bool fence_active_ DDS_GUARDED_BY(mu_) = false;
   bool epoch_collective_ = true;
@@ -1117,6 +1202,30 @@ class Store {
   std::map<void*, int64_t> cold_maps_ DDS_GUARDED_BY(cold_mu_);
   std::map<std::string, int> tier_placement_ DDS_GUARDED_BY(cold_mu_);
   std::atomic<int64_t> cold_placed_bytes_{0};
+
+  // -- SLO monitor state ---------------------------------------------------
+  // Per-tenant latency objectives evaluated over per-window histogram
+  // deltas. Leaf control-plane mutex — breaches are collected under it
+  // and trace events/flight dumps emitted AFTER it drops (the ddtrace
+  // no-emit-under-NO_BLOCKING discipline).
+  struct SloRule {
+    std::string tenant;
+    int tenant_id = 0;  // interned in metrics_ at configure time
+    int pct = 99;       // evaluated percentile (p50/p90/p99/...)
+    uint64_t threshold_ns = 0;
+    // Cumulative-aggregate baseline at the last evaluation: the
+    // per-window histogram is current - base (valid because cell
+    // counters and claims are monotone).
+    uint64_t base_hist[metrics::kBuckets] = {};
+    uint64_t base_count = 0;
+  };
+  mutable std::mutex slo_mu_ DDS_NO_BLOCKING;
+  std::vector<SloRule> slo_rules_ DDS_GUARDED_BY(slo_mu_);
+  int64_t slo_evals_ DDS_GUARDED_BY(slo_mu_) = 0;
+  int64_t slo_breaches_ DDS_GUARDED_BY(slo_mu_) = 0;
+  int slo_last_breach_tenant_ DDS_GUARDED_BY(slo_mu_) = -1;
+  uint64_t slo_last_eval_ns_ DDS_GUARDED_BY(slo_mu_) = 0;
+  long slo_window_ms_ = 0;  // DDSTORE_SLO_WINDOW_MS, ctor-resolved
 
   // -- integrity state -----------------------------------------------------
   // Reader-side verification on (DDSTORE_VERIFY=1 / ConfigureIntegrity).

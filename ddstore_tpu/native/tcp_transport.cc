@@ -43,7 +43,11 @@ enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
                      // three above): req.offset = first owner-local
                      // row, req.nbytes = row count; response payload =
                      // [int64 seq][count x uint64 sums].
-                     kOpRowSums = 9 };
+                     kOpRowSums = 9,
+                     // ddmetrics histogram pull (control plane):
+                     // response payload = the serving store's packed
+                     // metrics::CellRecord snapshot.
+                     kOpMetrics = 10 };
 
 #pragma pack(push, 1)
 struct WireReq {
@@ -756,7 +760,8 @@ void TcpTransport::HandleConnection(int fd) {
     // domain, so the data-plane schedules above are bit-identical with
     // this arm present or absent.
     if (req.op == kOpVarSeq || req.op == kOpRowSums ||
-        req.op == kOpSnapPin || req.op == kOpSnapUnpin) {
+        req.op == kOpSnapPin || req.op == kOpSnapUnpin ||
+        req.op == kOpMetrics) {
       FaultInjector& fi = FaultInjector::Get();
       if (fi.enabled()) {
         const FaultDecision fdec = fi.DrawCtrl(rank_);
@@ -842,6 +847,33 @@ void TcpTransport::HandleConnection(int fd) {
       iov[1] = iovec{&seq, sizeof(seq)};
       iov[2] = iovec{sums.data(), sums.size() * 8};
       if (SendIov(fd, iov, 3, send_deadline()) != 0) return;
+      continue;
+    }
+    if (req.op == kOpMetrics) {
+      // ddmetrics pull: serialize this store's live histogram cells.
+      // Control plane like kOpRowSums — above the data-path fault
+      // gate, bounded by the client's control-retry ladder.
+      WireResp resp{kErrNotFound, 0, 0};
+      std::string blob;
+      if (store_) {
+        const int64_t cap = store_->MetricsSnapshot(nullptr, 0);
+        blob.resize(static_cast<size_t>(cap));
+        const int64_t nb =
+            store_->MetricsSnapshot(blob.empty() ? nullptr : &blob[0],
+                                    cap);
+        blob.resize(nb > 0 ? static_cast<size_t>(nb) : 0);
+        resp.status = kOk;
+      }
+      if (resp.status != kOk) {
+        if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+        continue;
+      }
+      resp.nbytes = static_cast<int64_t>(blob.size());
+      iovec iov[2];
+      iov[0] = iovec{&resp, sizeof(resp)};
+      iov[1] = iovec{blob.empty() ? nullptr : &blob[0], blob.size()};
+      if (SendIov(fd, iov, blob.empty() ? 1 : 2, send_deadline()) != 0)
+        return;
       continue;
     }
     if (req.op == kOpSnapPin || req.op == kOpSnapUnpin) {
@@ -1386,6 +1418,56 @@ int TcpTransport::ReadRowSums(int target, const std::string& name,
   std::memcpy(sums, payload.data() + 8,
               static_cast<size_t>(count) * 8);
   return kOk;
+}
+
+int64_t TcpTransport::ReadMetrics(int target, void* out, int64_t cap) {
+  if (target < 0 || target >= world_ || target == rank_ || !out ||
+      cap < 0)
+    return kErrInvalidArg;
+  const std::function<bool(int)> suspect = SuspectSnapshot();
+  PingConn& pc = *ping_conns_[target];
+  WireResp resp;
+  std::string payload;
+  // Bulk-payload control op like ReadRowSums: a full snapshot is up to
+  // kMaxCells records (~400 KiB), so each attempt runs at 5x the base
+  // control deadline and a transport-failed round trip redials with
+  // the bounded ladder.
+  const long timeout_ms = control_timeout_ms_ * 5;
+  const int64_t worst =
+      static_cast<int64_t>(metrics::kMaxCells) *
+      static_cast<int64_t>(sizeof(metrics::CellRecord));
+  for (int att = 0;; ++att) {
+    // A detector-declared-dead peer classifies immediately: the
+    // cluster-view caller records the hole and moves on, burning no
+    // budget against a corpse.
+    if (suspect && suspect(target)) return kErrPeerLost;
+    if (stopping_.load(std::memory_order_relaxed)) return kErrTransport;
+    bool ok;
+    {
+      std::lock_guard<std::mutex> lock(pc.mu);
+      if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
+      ok = ControlRoundTrip(pc, kOpMetrics, std::string(), timeout_ms,
+                            &resp, /*tag=*/0, /*offset=*/0,
+                            /*nbytes=*/0, &payload,
+                            /*payload_cap=*/worst);
+    }
+    if (ok) break;
+    if (att >= control_retry_max_) return kErrTransport;
+    FaultSleepMs(ControlBackoffMs(att), &stopping_);
+  }
+  if (resp.status != kOk) return resp.status;
+  int64_t nb = static_cast<int64_t>(payload.size());
+  if (nb > cap) {
+    // Deliver what fits, truncated to whole records — the same
+    // cap-bounded contract Registry::Snapshot gives a local caller
+    // (binding callers size from the shared worst case and never hit
+    // this; a tight native cap must not read as a dead peer).
+    constexpr int64_t kRec =
+        static_cast<int64_t>(sizeof(metrics::CellRecord));
+    nb = cap - cap % kRec;
+  }
+  if (nb > 0) std::memcpy(out, payload.data(), static_cast<size_t>(nb));
+  return nb;
 }
 
 int TcpTransport::SnapshotControl(int target, int64_t snap_id, bool pin,
@@ -2212,6 +2294,9 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
         for (int r : t.results) ok = ok && r == kOk;
         if (ok) {
           cma_ops_.fetch_add(t.rq->n, std::memory_order_relaxed);
+          // ddmetrics route attribution, from the op's own thread
+          // (span_latency's rule: cma wins over tcp).
+          metrics::OpTimer::MarkRoute(metrics::kRouteCma);
           trace::Ev(trace::kCmaRead, rank_, t.rq->target, t.rq->n,
                     t.bytes);
           cma_ok_bytes += t.bytes;
@@ -2309,6 +2394,14 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
     any_bulk_req = any_bulk_req || total >= kBulkBytes;
     all_cma = all_cma && cma_ok;
   }
+  // ddmetrics route attribution: anything left here rides the wire
+  // leaves (marked on the op's own thread — the pool leaves below run
+  // without a token; cma above outranks this mark).
+  for (int64_t ri = 0; ri < nreqs; ++ri)
+    if (reqs[ri].n > 0) {
+      metrics::OpTimer::MarkRoute(metrics::kRouteTcp);
+      break;
+    }
   // One lane-count decision per batch, from the matching class's
   // tuner: the tuner's sample is bytes/wall-time over the WHOLE batch,
   // so every request in it must have striped at the same width for the

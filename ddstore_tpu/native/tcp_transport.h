@@ -213,6 +213,13 @@ class TcpTransport : public Transport {
   int SnapshotControl(int target, int64_t snap_id, bool pin,
                       const std::string& tenant) override
       DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
+  // ddmetrics histogram pull (kOpMetrics), over the same dedicated
+  // control connection: the peer's packed CellRecord snapshot lands in
+  // `out`. Never a data lane, never a DATA-plane injector draw (the
+  // ctrl arm injects server-side; the bounded control-retry ladder
+  // here absorbs it); a suspected peer short-circuits to kErrPeerLost.
+  int64_t ReadMetrics(int target, void* out, int64_t cap) override
+      DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // Per-tenant QoS lane budget: striped reads of `tenant`'s variables
   // engage at most `lanes` lanes (the cost-model scheduler plans these
   // as share-weighted splits of the tuned width; <= 0 clears). No

@@ -86,6 +86,9 @@ enum EventType : uint16_t {
   kCacheHit = 27,      // run served from the hot cache: a=first
                        // global row, b=bytes, c=owner rank
   kCacheEvict = 28,    // entry evicted: a=window id, b=bytes, c=0
+  kSloBreach = 29,     // tenant latency SLO breached: a=interned tenant
+                       // slot (ddmetrics), b=percentile (e.g. 99),
+                       // c=measured quantile lower bound (ns)
 };
 
 // Op classes for kOpBegin/kOpEnd `a`. Keep in sync with binding.py
@@ -107,6 +110,7 @@ enum FlightReason : int {
   kReasonManual = 5,
   kReasonCorrupt = 6,
   kReasonBarrierAbort = 7,
+  kReasonSloBreach = 8,
 };
 
 // The fixed-size dump record (48 bytes, packed, little-endian on every

@@ -28,11 +28,16 @@ from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
-from ..binding import (TRACE_EVENT_DTYPE, TRACE_FLIGHT_REASONS,
-                       TRACE_OP_CLASSES, TRACE_TYPES)
+from ..binding import (METRICS_BUCKETS, METRICS_CELL_DTYPE,
+                       METRICS_ROUTES, TRACE_EVENT_DTYPE,
+                       TRACE_FLIGHT_REASONS, TRACE_OP_CLASSES,
+                       TRACE_TYPES)
 
 __all__ = ["merge", "chrome_trace", "span_tree", "span_latency",
-           "trace_summary", "save_dump", "load_dump"]
+           "trace_summary", "save_dump", "load_dump",
+           "merge_metrics", "diff_metrics", "hist_percentile",
+           "latency_table", "latency_text", "prometheus_text",
+           "metrics_json", "save_metrics", "load_metrics"]
 
 
 def save_dump(path: str, events: np.ndarray) -> str:
@@ -241,6 +246,231 @@ def span_latency(events: np.ndarray) -> Dict[str, Dict]:
             "p50_ms": round(_percentile(v, 50), 4),
             "p99_ms": round(_percentile(v, 99), 4)}
         for k, v in samples.items()}
+
+
+# -- ddmetrics: live histogram cells (merge / percentiles / exporters) -------
+#
+# The native half (metrics_hist.{h,cc}) keeps per-store log2-bucketed
+# latency/bytes histograms per (op class, route, peer, reading tenant);
+# this half merges per-rank snapshots into one cluster view, derives
+# percentiles, and renders them for humans (terminal table), Prometheus
+# scrapers (exposition text) and dashboards (JSON).
+
+
+def save_metrics(path: str, cells: np.ndarray) -> str:
+    """Persist one rank's histogram snapshot
+    (``DDStore.metrics_snapshot()``) as a ``.npy`` the metrics CLI
+    consumes (``python -m ddstore_tpu.obs top``)."""
+    arr = np.asarray(cells, dtype=METRICS_CELL_DTYPE)
+    np.save(path, arr)
+    return path if path.endswith(".npy") else path + ".npy"
+
+
+def load_metrics(path: str) -> np.ndarray:
+    arr = np.load(path)
+    if arr.dtype != METRICS_CELL_DTYPE:
+        raise ValueError(f"{path}: not a ddstore metrics snapshot "
+                         f"(dtype {arr.dtype})")
+    return arr
+
+
+def _cell_key(c) -> tuple:
+    return (int(c["cls"]), int(c["route"]), int(c["peer"]),
+            bytes(c["tenant"]))
+
+
+def merge_metrics(snapshots: Iterable[np.ndarray]) -> np.ndarray:
+    """Merge per-rank cell snapshots into one cluster view: cells with
+    equal (class, route, peer, tenant) keys sum bucket-wise —
+    histograms compose exactly, unlike percentiles."""
+    out: Dict[tuple, np.ndarray] = {}
+    for snap in snapshots:
+        snap = np.asarray(snap, dtype=METRICS_CELL_DTYPE)
+        for c in snap:
+            k = _cell_key(c)
+            if k in out:
+                acc = out[k]
+                for f in ("count", "lat_sum_ns", "bytes_sum", "lat",
+                          "bytes"):
+                    acc[f] += c[f]
+            else:
+                out[k] = c.copy()
+    if not out:
+        return np.empty(0, dtype=METRICS_CELL_DTYPE)
+    return np.array([out[k] for k in sorted(out)],
+                    dtype=METRICS_CELL_DTYPE)
+
+
+def diff_metrics(begin: Optional[np.ndarray],
+                 end: np.ndarray) -> np.ndarray:
+    """Per-window delta of two cumulative snapshots of ONE store
+    (``end - begin`` bucket-wise; cells absent from ``begin`` delta
+    against zero). Counters are monotone EXCEPT across a
+    ``metrics_reset()``: a field that fell below its baseline reads as
+    "the window restarted at zero" (the raw end value), never as a
+    wrapped ~2^64 uint — the same clamp the native SLO window applies."""
+    end = np.asarray(end, dtype=METRICS_CELL_DTYPE)
+    if begin is None or len(begin) == 0:
+        return end.copy()
+    base = {_cell_key(c): c for c in
+            np.asarray(begin, dtype=METRICS_CELL_DTYPE)}
+    rows = []
+    for c in end:
+        b = base.get(_cell_key(c))
+        d = c.copy()
+        if b is not None:
+            for f in ("count", "lat_sum_ns", "bytes_sum"):
+                d[f] = d[f] - b[f] if d[f] >= b[f] else d[f]
+            for f in ("lat", "bytes"):
+                d[f] = np.where(d[f] >= b[f], d[f] - b[f], d[f])
+        if int(d["count"]) > 0:
+            rows.append(d)
+    return np.array(rows, dtype=METRICS_CELL_DTYPE) if rows \
+        else np.empty(0, dtype=METRICS_CELL_DTYPE)
+
+
+def hist_percentile(hist, q: float) -> int:
+    """The q-th percentile of a log2-bucketed histogram, reported as
+    the quantile bucket's UPPER bound (ns/bytes) — conservative, and
+    within one log2 bucket of the exact value by construction. 0 when
+    the histogram is empty."""
+    hist = np.asarray(hist, dtype=np.uint64)
+    n = int(hist.sum())
+    if n == 0:
+        return 0
+    want = -(-n * q // 100)  # ceil(q/100 * n)
+    cum = 0
+    for b, v in enumerate(hist):
+        cum += int(v)
+        if cum >= want:
+            return 1 << (b + 1)
+    return 1 << METRICS_BUCKETS
+
+
+def _cell_label(c) -> str:
+    cls = TRACE_OP_CLASSES.get(int(c["cls"]), str(int(c["cls"])))
+    route = METRICS_ROUTES.get(int(c["route"]), str(int(c["route"])))
+    tenant = bytes(c["tenant"]).split(b"\0", 1)[0].decode(
+        errors="replace")
+    return f"{cls}|{route}|{int(c['peer'])}|{tenant}"
+
+
+def latency_table(cells: np.ndarray) -> Dict[str, Dict]:
+    """``summary()["latency"]``'s payload: one row per cell keyed
+    ``"class|route|peer|tenant"`` with count, mean and conservative
+    p50/p90/p99 (bucket upper bounds, ms) plus the bytes side."""
+    cells = np.asarray(cells, dtype=METRICS_CELL_DTYPE)
+    out: Dict[str, Dict] = {}
+    for c in cells:
+        n = int(c["count"])
+        if n == 0:
+            continue
+        row = {
+            "count": n,
+            "mean_ms": round(int(c["lat_sum_ns"]) / n / 1e6, 4),
+            "p50_ms": round(hist_percentile(c["lat"], 50) / 1e6, 4),
+            "p90_ms": round(hist_percentile(c["lat"], 90) / 1e6, 4),
+            "p99_ms": round(hist_percentile(c["lat"], 99) / 1e6, 4),
+            "bytes": int(c["bytes_sum"]),
+            "p99_bytes": hist_percentile(c["bytes"], 99),
+        }
+        out[_cell_label(c)] = row
+    return out
+
+
+def latency_text(cells: np.ndarray, title: str = "live latency") -> str:
+    """Terminal rendering of :func:`latency_table` (the ``obs top``
+    view and the ``obs latency`` report's sibling)."""
+    table = latency_table(cells)
+    head = (f"{'class|route|peer|tenant':<36} {'count':>8} "
+            f"{'mean_ms':>9} {'p50_ms':>9} {'p90_ms':>9} "
+            f"{'p99_ms':>9} {'MB':>9}")
+    lines = [f"# {title}", head, "-" * len(head)]
+    for key in sorted(table):
+        r = table[key]
+        lines.append(
+            f"{key:<36} {r['count']:>8} {r['mean_ms']:>9.3f} "
+            f"{r['p50_ms']:>9.3f} {r['p90_ms']:>9.3f} "
+            f"{r['p99_ms']:>9.3f} {r['bytes'] / 1e6:>9.2f}")
+    if not table:
+        lines.append("(no samples)")
+    return "\n".join(lines)
+
+
+def _prom_escape(v: str) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote and newline must be escaped or the scraper rejects the whole
+    scrape, not just the one series."""
+    return v.replace("\\", r"\\").replace('"', r"\"") \
+            .replace("\n", r"\n")
+
+
+def prometheus_text(cells: np.ndarray,
+                    prefix: str = "ddstore") -> str:
+    """Prometheus exposition text: one classic histogram per cell
+    (``<prefix>_op_latency_seconds`` with cumulative ``le`` buckets,
+    ``_sum``/``_count``) plus ``<prefix>_op_bytes_total``. Labels:
+    class/route/peer/tenant."""
+    cells = np.asarray(cells, dtype=METRICS_CELL_DTYPE)
+    lines = [
+        f"# HELP {prefix}_op_latency_seconds "
+        f"Store op latency (log2 buckets).",
+        f"# TYPE {prefix}_op_latency_seconds histogram",
+    ]
+    byte_lines = [
+        f"# HELP {prefix}_op_bytes_total Bytes delivered by store ops.",
+        f"# TYPE {prefix}_op_bytes_total counter",
+    ]
+    for c in cells:
+        n = int(c["count"])
+        if n == 0:
+            continue
+        cls = TRACE_OP_CLASSES.get(int(c["cls"]), str(int(c["cls"])))
+        route = METRICS_ROUTES.get(int(c["route"]),
+                                   str(int(c["route"])))
+        tenant = _prom_escape(bytes(c["tenant"]).split(b"\0", 1)[0]
+                              .decode(errors="replace"))
+        labels = (f'class="{cls}",route="{route}",'
+                  f'peer="{int(c["peer"])}",tenant="{tenant}"')
+        cum = 0
+        for b in range(METRICS_BUCKETS):
+            v = int(c["lat"][b])
+            if v == 0:
+                continue
+            cum += v
+            le = (1 << (b + 1)) / 1e9
+            lines.append(f"{prefix}_op_latency_seconds_bucket"
+                         f"{{{labels},le=\"{le:g}\"}} {cum}")
+        lines.append(f"{prefix}_op_latency_seconds_bucket"
+                     f"{{{labels},le=\"+Inf\"}} {n}")
+        # Full ns precision (never %g): at 6 significant digits a
+        # long-lived sum stops moving between scrapes and
+        # rate(..._sum) flatlines while ops are flowing.
+        lines.append(f"{prefix}_op_latency_seconds_sum{{{labels}}} "
+                     f"{int(c['lat_sum_ns']) / 1e9:.9f}")
+        lines.append(f"{prefix}_op_latency_seconds_count{{{labels}}} "
+                     f"{n}")
+        byte_lines.append(f"{prefix}_op_bytes_total{{{labels}}} "
+                          f"{int(c['bytes_sum'])}")
+    return "\n".join(lines + byte_lines) + "\n"
+
+
+def metrics_json(cells: np.ndarray) -> Dict:
+    """JSON-serializable dump of the cells: the latency table plus the
+    raw bucket arrays (dashboards re-bucket/re-aggregate from these)."""
+    cells = np.asarray(cells, dtype=METRICS_CELL_DTYPE)
+    out: Dict = {"buckets": METRICS_BUCKETS, "cells": {}}
+    for c in cells:
+        if int(c["count"]) == 0:
+            continue
+        out["cells"][_cell_label(c)] = {
+            "count": int(c["count"]),
+            "lat_sum_ns": int(c["lat_sum_ns"]),
+            "lat": [int(v) for v in c["lat"]],
+            "bytes_sum": int(c["bytes_sum"]),
+            "bytes": [int(v) for v in c["bytes"]],
+        }
+    return out
 
 
 def trace_summary(stats: Dict, events: Optional[np.ndarray] = None) -> Dict:

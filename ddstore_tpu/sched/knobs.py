@@ -109,6 +109,12 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
        desc="bench integrity-phase subprocess cap, default 300"),
     _k("DDSTORE_LANES_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_METHOD", "config"),
+    _k("DDSTORE_METRICS", "config",
+       desc="0 disables the always-on ddmetrics latency/bytes "
+            "histograms (default 1: per-store log2-bucketed cells per "
+            "(op class, route, peer, reading tenant), updated at op "
+            "end with relaxed atomic increments — live p50/p90/p99 in "
+            "summary()['latency'] without tracing)"),
     _k("DDSTORE_NUM_PROCESSES", "config",
        desc="explicit pod size for pod_bootstrap (with "
             "DDSTORE_COORDINATOR/DDSTORE_PROCESS_ID)"),
@@ -139,6 +145,13 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
        desc="0 disables the cost-model scheduler (independent tuners "
             "only); default on"),
     _k("DDSTORE_SCHED_PHASE_TIMEOUT_S", "config"),
+    _k("DDSTORE_SLO_PHASE_TIMEOUT_S", "config",
+       desc="bench slo-phase subprocess cap, default 300"),
+    _k("DDSTORE_SLO_WINDOW_MS", "config",
+       desc="minimum spacing between SLO evaluations (ms): an "
+            "evaluate_slos() call inside the window is a no-op that "
+            "keeps the running delta window intact; default 0 = every "
+            "call evaluates"),
     _k("DDSTORE_SOAK_BUDGET_S", "config"),
     _k("DDSTORE_SOAK_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_TENANTS_PHASE_TIMEOUT_S", "config",
@@ -163,6 +176,13 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
             "DDSTORE_TIER_COLD_DIR"),
     _k("DDSTORE_TIERED_PHASE_TIMEOUT_S", "config",
        desc="bench tiered-phase subprocess cap, default 300"),
+    _k("DDSTORE_TENANT_SLOS", "config",
+       desc="per-tenant latency objectives 't=p99:5ms,...' (a bare "
+            "'p99:5ms' names the default tenant; units ns/us/ms/s) "
+            "evaluated per epoch window over the live ddmetrics "
+            "histograms — a breach emits an slo_breach trace event, "
+            "dumps the flight recorder and replans the tenant's "
+            "routes/lanes/shares; default unset = monitor inert"),
     _k("DDSTORE_TENANT_SHARES", "config",
        desc="per-tenant QoS weights 't=weight,...': async admission "
             "is share-split (each tenant runs at most max(1, width * "

@@ -963,6 +963,121 @@ class DDStore:
         events = binding.trace_dump() if st.get("enabled") else None
         return trace_summary(st, events)
 
+    # -- ddmetrics: live latency histograms + SLO monitor ------------------
+    #
+    # Per-store (unlike the process-global trace rings), always-on
+    # (DDSTORE_METRICS, default 1): log2-bucketed latency/bytes
+    # histograms per (op class, route, peer, reading tenant), updated
+    # at op end with a few relaxed atomic increments — live
+    # p50/p90/p99 WITHOUT tracing. ``cluster_metrics`` pulls every
+    # peer's snapshot over the control plane and merges one cluster
+    # view; the SLO monitor evaluates per-tenant objectives over
+    # per-window deltas of the same histograms.
+
+    def metrics_configure(self, enabled: int) -> None:
+        """Flip this store's histograms at runtime (0/1; -1 keeps).
+        Load-time knob: ``DDSTORE_METRICS`` (default on)."""
+        self._native.metrics_configure(enabled)
+
+    def metrics_enabled(self) -> bool:
+        return self._native.metrics_enabled()
+
+    def metrics_reset(self) -> None:
+        self._native.metrics_reset()
+
+    def metrics_snapshot(self):
+        """This rank's live histogram cells
+        (``binding.METRICS_CELL_DTYPE`` structured array)."""
+        return self._native.metrics_snapshot()
+
+    def metrics_pull(self, target: int):
+        """One peer's cells over the control plane (``kOpMetrics`` on
+        the dedicated heartbeat connection — never a data lane, never
+        an injector draw; bounded by the control-retry ladder). Raises
+        ``DDStoreError(ERR_PEER_LOST)`` for a suspected/dead peer."""
+        return self._native.metrics_pull(target)
+
+    def cluster_metrics(self):
+        """The CLUSTER latency surface: every reachable rank's cells
+        merged bucket-wise (``obs.merge_metrics``). Returns
+        ``(cells, dead)`` where ``dead`` lists peers that could not be
+        pulled (suspected/unreachable — the view assembles around
+        them, no give-up, no exception)."""
+        from .binding import DDStoreError
+        from .obs import merge_metrics
+
+        snaps = []
+        dead = []
+        for r in range(self.world):
+            try:
+                snaps.append(self.metrics_snapshot() if r == self.rank
+                             else self.metrics_pull(r))
+            except DDStoreError:
+                dead.append(r)
+        return merge_metrics(snaps), dead
+
+    def metrics_stats(self) -> dict:
+        """Histogram registry counters
+        (``binding.METRICS_STAT_KEYS``)."""
+        return self._native.metrics_stats()
+
+    def metrics_summary(self) -> dict:
+        """The ``summary()["latency"]`` payload: per-cell count/mean/
+        p50/p90/p99 (``obs.latency_table`` over this rank's live
+        cells). ``DeviceLoader.metrics`` wires this in automatically
+        and reports per-epoch deltas."""
+        from .obs import latency_table
+
+        return latency_table(self.metrics_snapshot())
+
+    def set_tenant_slos(self, spec: str) -> None:
+        """Replace the per-tenant latency objectives
+        (``"t=p99:5ms,t2=p50:200us"``; a bare ``"p99:5ms"`` names the
+        default tenant; empty clears). Evaluation windows restart at
+        NOW. Load-time knob: ``DDSTORE_TENANT_SLOS``."""
+        self._native.slo_configure(spec)
+        self._last_slo_breaches = []
+
+    def evaluate_slos(self) -> list:
+        """Evaluate every objective over the histogram delta since the
+        last evaluation (rate-limited by ``DDSTORE_SLO_WINDOW_MS``).
+        Returns breach dicts ``{tenant, pct, threshold_ms,
+        measured_ms, count}``; each breach has already emitted a
+        ``slo_breach`` trace event and dumped the flight recorder
+        (while tracing is on). The loader calls this at epoch
+        boundaries and fires the scheduler's replan trigger per
+        breached tenant."""
+        evals_before = self._native.slo_stats()["evaluations"]
+        rows = self._native.slo_evaluate()
+        out = []
+        if rows:
+            tenants = self._native.metrics_tenants()
+            for slot, pct, thr_ns, low_ns, count in rows:
+                tenant = tenants[slot] if 0 <= slot < len(tenants) \
+                    else f"slot{slot}"
+                out.append({"tenant": tenant, "pct": int(pct),
+                            "threshold_ms": thr_ns / 1e6,
+                            "measured_ms": low_ns / 1e6,
+                            "count": int(count)})
+        # A rate-limited call (inside DDSTORE_SLO_WINDOW_MS) is not an
+        # evaluation: keep the previous verdict on the books.
+        if rows or \
+                self._native.slo_stats()["evaluations"] != evals_before:
+            self._last_slo_breaches = out
+        return out
+
+    def slo_stats(self) -> dict:
+        """SLO monitor counters (``binding.SLO_STAT_KEYS``)."""
+        return self._native.slo_stats()
+
+    def slo_summary(self) -> dict:
+        """The ``summary()["slo"]`` payload: monitor counters plus the
+        most recent evaluation's breach list."""
+        out = dict(self.slo_stats())
+        out["last_breaches"] = list(
+            getattr(self, "_last_slo_breaches", []))
+        return out
+
     # -- replication / failover / health ----------------------------------
 
     @property

@@ -217,6 +217,20 @@ class PipelineMetrics:
         self._tiering_source: Optional[Callable[[], Dict]] = None
         self._tiering_begin: Optional[Dict] = None
         self._tiering_end: Optional[Dict] = None
+        # ddmetrics source (DDStore.metrics_snapshot — the RAW cell
+        # array, not a dict: histograms delta bucket-wise, percentiles
+        # don't). summary()["latency"] reports this epoch's live
+        # p50/p90/p99 per (class, route, peer, tenant) with tracing
+        # off — the always-on latency surface.
+        self._latency_source: Optional[Callable[[], object]] = None
+        self._latency_begin = None
+        self._latency_end = None
+        # SLO source (DDStore.slo_summary): summary()["slo"] carries
+        # the monitor's per-epoch evaluation/breach deltas plus the
+        # last evaluation's breach list.
+        self._slo_source: Optional[Callable[[], Dict]] = None
+        self._slo_begin: Optional[Dict] = None
+        self._slo_end: Optional[Dict] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -494,6 +508,80 @@ class PipelineMetrics:
             if consulted else 0.0
         return out
 
+    def set_latency_source(self,
+                           source: Optional[Callable[[], object]]) \
+            -> None:
+        """Attach a zero-arg callable returning the live histogram
+        cell array (``DDStore.metrics_snapshot``). Snapshotted at
+        epoch boundaries; ``summary()["latency"]`` reports THIS
+        epoch's per-cell count/mean/p50/p90/p99 (bucket-wise delta,
+        then percentiles — the only order that is correct)."""
+        self._latency_source = source
+
+    def _snap_latency(self):
+        if self._latency_source is None:
+            return None
+        try:
+            return self._latency_source()
+        except Exception:
+            return None
+
+    def latency_summary(self) -> Dict:
+        """Per-epoch live-latency view: the epoch's histogram delta
+        rendered as ``obs.latency_table`` rows keyed
+        ``"class|route|peer|tenant"``."""
+        if self._latency_begin is None and self._latency_source is None:
+            return {}
+        end = self._latency_end if self._latency_end is not None \
+            else self._snap_latency()
+        if end is None:
+            return {}
+        from ..obs import diff_metrics, latency_table
+
+        try:
+            return latency_table(diff_metrics(self._latency_begin, end))
+        except Exception:
+            return {}
+
+    #: gauge keys of the SLO source (reported raw, never delta'd —
+    #: keep in sync with binding.SLO_GAUGE_KEYS); "last_breaches" (a
+    #: list) also passes through live.
+    SLO_GAUGES = ("rules", "window_ms", "last_breach_tenant_slot")
+
+    def set_slo_source(self,
+                       source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning the SLO monitor's
+        payload (``DDStore.slo_summary``). Snapshotted at epoch
+        boundaries; ``summary()["slo"]`` reports per-epoch
+        evaluation/breach deltas with the gauges and the last breach
+        list live."""
+        self._slo_source = source
+
+    def _snap_slo(self) -> Optional[Dict]:
+        if self._slo_source is None:
+            return None
+        try:
+            return dict(self._slo_source())
+        except Exception:
+            return None
+
+    def slo_summary(self) -> Dict:
+        """Per-epoch SLO view: evaluations/breaches this epoch plus
+        the configured-rule gauges and the most recent breach list."""
+        out: Dict = {}
+        if self._slo_begin is None:
+            return out
+        end = self._slo_end if self._slo_end is not None \
+            else self._snap_slo()
+        if end is None:
+            return out
+        for k, v in end.items():
+            if k in self.SLO_GAUGES or k == "last_breaches":
+                out[k] = v
+            else:
+                out[k] = max(0, int(v) - int(self._slo_begin.get(k, 0)))
+        return out
+
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
             -> None:
         """Attach a zero-arg callable returning the cost-model
@@ -639,6 +727,10 @@ class PipelineMetrics:
         self._integrity_end = None
         self._tiering_begin = self._snap_tiering()
         self._tiering_end = None
+        self._latency_begin = self._snap_latency()
+        self._latency_end = None
+        self._slo_begin = self._snap_slo()
+        self._slo_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -662,6 +754,8 @@ class PipelineMetrics:
         self._trace_end = self._snap_trace()
         self._integrity_end = self._snap_integrity()
         self._tiering_end = self._snap_tiering()
+        self._latency_end = self._snap_latency()
+        self._slo_end = self._snap_slo()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -749,6 +843,20 @@ class PipelineMetrics:
                           if k not in self.TIERING_GAUGES
                           and k != "cache_hit_rate")):
             out["tiering"] = tg
+        lat = self.latency_summary()
+        # Included whenever any cell recorded this epoch: the live
+        # latency surface is THE always-on observability product —
+        # absent only when metrics are disabled or nothing ran.
+        if lat:
+            out["latency"] = lat
+        slo = self.slo_summary()
+        # Included while any objective is configured (an all-zero
+        # breach row is the "every tenant met its SLO" result the slo
+        # bench reads) or any monitor activity fired.
+        if slo and (slo.get("rules", 0) > 0
+                    or slo.get("evaluations", 0)
+                    or slo.get("breaches", 0)):
+            out["slo"] = slo
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself

@@ -25,7 +25,8 @@ NATIVE = os.path.join(HERE, "ddstore_tpu", "native")
 # in the package here would trigger its lazy native build mid-setup).
 SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
            "worker_pool.cc", "cma.cc", "fault.cc", "health.cc",
-           "integrity.cc", "tier.cc", "trace.cc", "capi.cc"]
+           "integrity.cc", "metrics_hist.cc", "tier.cc", "trace.cc",
+           "capi.cc"]
 
 
 def compile_native(out_dir: str) -> str:

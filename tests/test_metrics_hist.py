@@ -1,0 +1,597 @@
+"""ddmetrics (ISSUE 14): always-on native latency histograms, the
+cross-rank metrics plane, and SLO breach detection.
+
+Contracts pinned here:
+
+* log2 bucket math: known samples land in their buckets, percentiles
+  come back as the quantile bucket's upper bound;
+* live ``summary()["latency"]``-grade percentiles are available per
+  (class, route, peer, tenant) with tracing OFF — the always-on
+  substrate the roadmap's SLO serving story needs;
+* route/tenant attribution matches ``obs.span_latency``'s rules, and a
+  traced run's live p99 agrees with the trace-derived p99 within one
+  log2 bucket;
+* the cross-rank pull (kOpMetrics) merges every reachable peer's cells
+  and short-circuits a suspected/dead peer to ``ERR_PEER_LOST`` with
+  zero retry-budget burn (no giveups — the cluster view assembles
+  around the corpse);
+* the SLO monitor is INERT while unconfigured (identical bytes AND
+  identical seeded-fault counters with the monitor on vs off), and a
+  provable breach emits one ``slo_breach`` trace event, one flight
+  dump, and drives the scheduler's replan trigger;
+* the Prometheus exporter's line format is pinned by a golden test,
+  and the ``obs`` CLI grew ``latency``/``top``/``metrics`` paths.
+
+Everything runs on in-process backends (ThreadGroup TCP / local) —
+tier-1 required, no accelerator, no skip paths.
+"""
+
+import math
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from ddstore_tpu import DDStore, DDStoreError, ThreadGroup, fault_configure
+from ddstore_tpu import binding, obs
+from ddstore_tpu.binding import (ERR_PEER_LOST, METRICS_CELL_DTYPE,
+                                 METRICS_ROUTE_CODES, TRACE_TYPE_CODES)
+from ddstore_tpu.sched.planner import Scheduler
+from ddstore_tpu.utils.metrics import PipelineMetrics
+
+pytestmark = pytest.mark.tier1_required
+
+ROWS, DIM = 128, 8
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    """Tracing off, rings trimmed, injector disarmed after every test
+    (both are process-global; the metrics registries die with their
+    per-test stores)."""
+    yield
+    binding.trace_configure(0, 4096)
+    binding.trace_reset()
+    fault_configure("", 0)
+
+
+@pytest.fixture(autouse=True)
+def _wire_only(monkeypatch):
+    """Force remote reads onto the TCP wire (route attribution under
+    test) with tight retry budgets."""
+    monkeypatch.setenv("DDSTORE_CMA", "0")
+    monkeypatch.setenv("DDSTORE_TCP_LANES", "1")
+    monkeypatch.setenv("DDSTORE_RETRY_MAX", "4")
+    monkeypatch.setenv("DDSTORE_RETRY_BASE_MS", "2")
+    monkeypatch.setenv("DDSTORE_OP_DEADLINE_S", "30")
+
+
+def _run_pair(body0, world=2):
+    """Two-rank ThreadGroup TCP store; rank r's shard is all (r+1).
+    Rank 0 runs ``body0(store)``; errors from either rank propagate."""
+    name = uuid.uuid4().hex
+    errors = []
+    result = {}
+
+    def worker(rank):
+        try:
+            g = ThreadGroup(name, rank, world)
+            with DDStore(g, backend="tcp") as s:
+                s.add("v", np.full((ROWS, DIM), rank + 1, np.float32))
+                if rank == 0:
+                    result["out"] = body0(s)
+                s.barrier()
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(180)
+    if errors:
+        raise errors[0][1]
+    assert not any(t.is_alive() for t in ts), "rank thread hung"
+    return result.get("out")
+
+
+def _cells_by_key(cells):
+    out = {}
+    for c in np.asarray(cells, dtype=METRICS_CELL_DTYPE):
+        cls = binding.TRACE_OP_CLASSES[int(c["cls"])]
+        route = binding.METRICS_ROUTES[int(c["route"])]
+        tenant = bytes(c["tenant"]).split(b"\0", 1)[0].decode()
+        out[f"{cls}|{route}|{int(c['peer'])}|{tenant}"] = c
+    return out
+
+
+# -- bucket math --------------------------------------------------------------
+
+def test_bucket_math_units():
+    """Known synthetic samples land in floor(log2) buckets; the
+    percentile read-out is the quantile bucket's upper bound."""
+    with DDStore(backend="local") as s:
+        rec = s._native.metrics_record
+        # lat 1500 ns -> bucket 10 ([1024, 2048)); bytes 10 -> bucket 3.
+        rec(1, METRICS_ROUTE_CODES["tcp"], 2, "eval", 1500, 10)
+        # lat 3000 ns -> bucket 11; bytes 1 -> bucket 0.
+        rec(1, METRICS_ROUTE_CODES["tcp"], 2, "eval", 3000, 1)
+        # lat 0 and 1 both -> bucket 0.
+        rec(0, 0, -1, "", 0, 0)
+        rec(0, 0, -1, "", 1, 1)
+        cells = _cells_by_key(s.metrics_snapshot())
+        c = cells["get_batch|tcp|2|eval"]
+        assert int(c["count"]) == 2
+        assert int(c["lat_sum_ns"]) == 4500
+        assert int(c["lat"][10]) == 1 and int(c["lat"][11]) == 1
+        assert int(c["bytes"][3]) == 1 and int(c["bytes"][0]) == 1
+        z = cells["get|local|-1|"]
+        assert int(z["lat"][0]) == 2
+        # Loud validation (review finding): out-of-range class/route/
+        # peer raise instead of silently dropping the sample.
+        for bad in ((9, 0, -1), (0, 7, -1), (0, 0, -2)):
+            with pytest.raises(DDStoreError):
+                rec(*bad, "", 1, 1)
+        # Percentiles: p50 of {b10, b11} is bucket 10 -> upper 2048;
+        # p99 is bucket 11 -> upper 4096.
+        assert obs.hist_percentile(c["lat"], 50) == 2048
+        assert obs.hist_percentile(c["lat"], 99) == 4096
+        assert obs.hist_percentile(np.zeros(44, np.uint64), 99) == 0
+
+
+def test_disabled_records_nothing():
+    with DDStore(backend="local") as s:
+        s.add("v", np.arange(ROWS * DIM, dtype=np.float32).reshape(
+            ROWS, DIM))
+        s.metrics_configure(0)
+        assert not s.metrics_enabled()
+        before = s.metrics_stats()["ops_recorded"]
+        s.get_batch("v", np.arange(32))
+        assert s.metrics_stats()["ops_recorded"] == before
+        s.metrics_configure(1)
+        s.get_batch("v", np.arange(32))
+        assert s.metrics_stats()["ops_recorded"] > before
+
+
+# -- live percentiles without tracing ----------------------------------------
+
+def test_live_latency_without_trace():
+    """p50/p90/p99 per (class, route, peer, tenant) are live with
+    DDSTORE_TRACE=0 — the headline contract."""
+    binding.trace_configure(0)
+
+    def body(s):
+        assert not binding.trace_enabled()
+        rng = np.random.default_rng(5)
+        for _ in range(6):
+            s.get_batch("v", rng.integers(0, 2 * ROWS, 64))
+        s.get("v", ROWS, 4)         # remote single read, peer 1
+        s.get("v", 0, 4)            # local read, peer 0
+        table = s.metrics_summary()
+        # Scatter batches crossed the wire -> route tcp, multi-peer.
+        row = table["get_batch|tcp|-1|"]
+        assert row["count"] == 6
+        assert row["p99_ms"] >= row["p50_ms"] > 0
+        assert row["bytes"] == 6 * 64 * DIM * 4
+        assert table["get|tcp|1|"]["count"] == 1
+        assert table["get|local|0|"]["count"] == 1
+        return True
+
+    assert _run_pair(body)
+
+
+def test_tenant_attribution():
+    """A named tenant reading the shared default namespace records
+    under ITS OWN cell — the as_tenant rule QoS shares use."""
+    def body(s):
+        eval_h = s.attach("eval")
+        eval_h.get_batch("v", np.arange(ROWS, ROWS + 32))
+        cells = _cells_by_key(s.metrics_snapshot())
+        assert "get_batch|tcp|-1|eval" in cells, sorted(cells)
+        return True
+
+    assert _run_pair(body)
+
+
+def test_live_p99_agrees_with_span_latency():
+    """Same traced run: the live histogram p99 and the trace-derived
+    span_latency p99 agree within one log2 bucket (the live read-out
+    is the bucket upper bound by construction)."""
+    binding.trace_configure(1)
+    binding.trace_reset()
+
+    def body(s):
+        s.metrics_reset()
+        rng = np.random.default_rng(11)
+        for _ in range(24):
+            s.get_batch("v", rng.integers(0, 2 * ROWS, 96))
+        live = _cells_by_key(s.metrics_snapshot())["get_batch|tcp|-1|"]
+        ev = binding.trace_dump()
+        span = obs.span_latency(ev)["get_batch|tcp|-1"]
+        assert span["count"] == 24 and int(live["count"]) == 24
+        p99_live_ns = obs.hist_percentile(live["lat"], 99)
+        p99_trace_ns = span["p99_ms"] * 1e6
+        assert p99_trace_ns > 0
+        # live bucket (upper bound 2^(b+1) -> b) vs the exact value's.
+        b_live = int(math.log2(p99_live_ns)) - 1
+        b_trace = int(math.log2(p99_trace_ns))
+        assert abs(b_live - b_trace) <= 1, (p99_live_ns, p99_trace_ns)
+        return True
+
+    assert _run_pair(body)
+
+
+# -- cross-rank metrics plane -------------------------------------------------
+
+def test_cluster_pull_merges_and_skips_dead():
+    """Every reachable rank's cells merge bucket-wise; a dead/suspected
+    peer is skipped with ERR_PEER_LOST classification and ZERO retry
+    giveups (detector short-circuit, not a burned ladder)."""
+    name = uuid.uuid4().hex
+    world = 3
+    stores = {}
+    ready = threading.Barrier(world)
+    done = threading.Barrier(world)
+    errors = []
+
+    def worker(rank):
+        try:
+            g = ThreadGroup(name, rank, world)
+            s = DDStore(g, backend="local")
+            stores[rank] = s
+            s.add("v", np.full((ROWS, DIM), rank + 1, np.float32))
+            ready.wait()
+            # Every rank records 4 local batches into ITS registry.
+            for _ in range(4):
+                s.get_batch("v", np.arange(rank * ROWS,
+                                           rank * ROWS + 16))
+            done.wait()
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    ts = [threading.Thread(target=worker, args=(r,))
+          for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errors, errors
+    s = stores[0]
+    cells, dead = s.cluster_metrics()
+    assert dead == []
+    merged = _cells_by_key(cells)
+    # 3 ranks x 4 batches merged into the one shared-key cell.
+    assert int(merged["get_batch|local|-1|"]["count"]) == 12
+    # Kill rank 2, suspect it: the pull must classify, not retry.
+    stores[2]._native.close()
+    s.mark_suspect(2)
+    g0 = s.fault_stats()["retry_giveups"]
+    with pytest.raises(DDStoreError) as ei:
+        s.metrics_pull(2)
+    assert ei.value.code == ERR_PEER_LOST
+    cells2, dead2 = s.cluster_metrics()
+    assert dead2 == [2]
+    assert int(_cells_by_key(cells2)["get_batch|local|-1|"]["count"]) == 8
+    assert s.fault_stats()["retry_giveups"] == g0
+    for st in stores.values():
+        st._native.close()
+
+
+# -- SLO monitor --------------------------------------------------------------
+
+def _seeded_workload(s, with_slos):
+    """Deterministic scatter reads under a seeded fault schedule; with
+    the monitor armed, every other batch is followed by an evaluation
+    — the monitor must not perturb the data path either way."""
+    if with_slos:
+        s.set_tenant_slos("p99:1s,eval=p90:1s")  # far above reality
+    fault_configure("reset:0.3,delay:0.1:2", 77)
+    try:
+        outs = []
+        rng = np.random.default_rng(3)
+        for i in range(12):
+            idx = rng.integers(0, 2 * ROWS, 96)
+            outs.append(s.get_batch("v", idx).copy())
+            if with_slos and i % 2 == 1:
+                assert s.evaluate_slos() == []
+        fs = s.fault_stats()
+    finally:
+        fault_configure("", 0)
+    counters = {k: fs[k] for k in
+                ("fault_checks", "injected_reset", "injected_trunc",
+                 "injected_delay", "injected_stall")}
+    return np.concatenate(outs), counters
+
+
+def test_slo_off_state_seeded_fault_identity():
+    """Monitor unconfigured vs armed-and-evaluating: byte-identical
+    data AND identical injector counters — the monitor reads counters,
+    never the data path."""
+    out_off, fs_off = _run_pair(lambda s: _seeded_workload(s, False))
+    out_on, fs_on = _run_pair(lambda s: _seeded_workload(s, True))
+    np.testing.assert_array_equal(out_off, out_on)
+    assert fs_off == fs_on, (fs_off, fs_on)
+    assert fs_on["injected_reset"] > 0  # the schedule actually injected
+
+
+def test_breach_emits_flight_dump_and_drives_replan():
+    """A provable p99 breach: one slo_breach trace event, ONE flight
+    dump naming the reason, summary()["slo"] carries the verdict, and
+    the scheduler's degradation trigger replans."""
+    binding.trace_configure(1)
+    binding.trace_reset()
+
+    def body(s):
+        sched = Scheduler(s, enabled=True)
+        pm = PipelineMetrics()
+        pm.set_latency_source(s.metrics_snapshot)
+        pm.set_slo_source(s.slo_summary)
+        pm.epoch_start()
+        s.set_tenant_slos("p99:1ns")  # any real op provably breaches
+        flights0 = binding.trace_stats()["flight_dumps"]
+        replans0 = sched.replans
+        s.get_batch("v", np.arange(ROWS, ROWS + 64))
+        breaches = s.evaluate_slos()
+        assert len(breaches) == 1
+        b = breaches[0]
+        assert b["tenant"] == "" and b["pct"] == 99
+        assert b["measured_ms"] > b["threshold_ms"]
+        # Exactly one flight dump, reason slo_breach, event recorded.
+        assert binding.trace_stats()["flight_dumps"] == flights0 + 1
+        fl = binding.trace_flight_dump()
+        kinds = [int(e["type"]) for e in fl]
+        assert TRACE_TYPE_CODES["slo_breach"] in kinds
+        marker = fl[-1]
+        assert int(marker["type"]) == TRACE_TYPE_CODES["flight"]
+        assert binding.TRACE_FLIGHT_REASONS[int(marker["a"])] == \
+            "slo_breach"
+        # The loader's trigger path: one replan per breached tenant.
+        for br in breaches:
+            sched.on_degradation(f"slo:{br['tenant']}")
+        assert sched.replans == replans0 + 1
+        assert any(r.startswith("degraded:slo:") for r in sched.reasons)
+        pm.epoch_end()
+        summ = pm.summary()
+        assert summ["slo"]["breaches"] == 1
+        assert summ["slo"]["last_breaches"][0]["tenant"] == ""
+        assert any(k.startswith("get_batch|tcp")
+                   for k in summ["latency"])
+        # A second evaluation with no fresh traffic: no new breach
+        # (idle window -> no verdict), no second flight dump.
+        assert s.evaluate_slos() == []
+        assert binding.trace_stats()["flight_dumps"] == flights0 + 1
+        return True
+
+    assert _run_pair(body)
+
+
+def test_slo_window_rate_limit(monkeypatch):
+    """Inside DDSTORE_SLO_WINDOW_MS an evaluate call is a no-op that
+    keeps the running window intact (evaluations counter unmoved)."""
+    monkeypatch.setenv("DDSTORE_SLO_WINDOW_MS", "60000")
+    with DDStore(backend="local") as s:
+        s.add("v", np.zeros((ROWS, DIM), np.float32))
+        s.set_tenant_slos("p99:1ns")
+        s.get_batch("v", np.arange(32))
+        assert len(s.evaluate_slos()) == 1     # first call evaluates
+        assert s.slo_stats()["evaluations"] == 1
+        s.get_batch("v", np.arange(32))
+        assert s.evaluate_slos() == []         # rate-limited, no eval
+        assert s.slo_stats()["evaluations"] == 1
+        assert s.slo_stats()["window_ms"] == 60000
+        # The rate-limited call kept last_breaches on the books.
+        assert s.slo_summary()["last_breaches"]
+
+
+def test_async_op_records_exactly_one_sample():
+    """ONE op = ONE sample: a get_batch_async records its
+    issue->completion bracket (async_batch) and NOT the inner
+    execution leg too — double-counting would dilute the tenant's SLO
+    quantile with the faster execution legs and mask a queueing-driven
+    breach (review finding, pinned)."""
+    with DDStore(backend="local") as s:
+        s.add("v", np.zeros((ROWS, DIM), np.float32))
+        before = s.metrics_stats()["ops_recorded"]
+        h = s.get_batch_async("v", np.arange(32))
+        h.wait()
+        h.release()
+        assert s.metrics_stats()["ops_recorded"] == before + 1
+        cells = _cells_by_key(s.metrics_snapshot())
+        assert int(cells["async_batch|local|-1|"]["count"]) == 1
+        assert "get_batch|local|-1|" not in cells
+        # A plain sync get_batch still records normally.
+        s.get_batch("v", np.arange(32))
+        cells = _cells_by_key(s.metrics_snapshot())
+        assert int(cells["get_batch|local|-1|"]["count"]) == 1
+
+
+def test_diff_metrics_clamps_across_reset():
+    """A mid-epoch metrics_reset() drops the end snapshot below the
+    epoch baseline: the delta must read restarted-at-zero, never a
+    wrapped ~2^64 uint row (review finding, pinned — the Python twin
+    of the native SLO clamp)."""
+    begin = np.zeros(1, dtype=METRICS_CELL_DTYPE)
+    begin[0]["cls"], begin[0]["route"], begin[0]["peer"] = 1, 1, -1
+    begin[0]["count"], begin[0]["lat_sum_ns"] = 10, 50000
+    begin[0]["lat"][10] = 10
+    end = begin.copy()
+    end[0]["count"], end[0]["lat_sum_ns"] = 3, 9000  # post-reset
+    end[0]["lat"][10] = 3
+    d = obs.diff_metrics(begin, end)
+    assert int(d[0]["count"]) == 3
+    assert int(d[0]["lat_sum_ns"]) == 9000
+    assert int(d[0]["lat"][10]) == 3
+
+
+def test_cache_fill_not_recorded_as_tenant_traffic():
+    """Detached readahead-warming fills (the slowest reads in the
+    system) must not pollute the tenant's SLO latency surface — the
+    tenant never waited on them (review finding, pinned)."""
+    import time as _time
+
+    with DDStore(backend="local") as s:
+        s.add("v", np.zeros((ROWS, DIM), np.float32))
+        s.tier_configure(16 << 20)
+        before = s.metrics_stats()["ops_recorded"]
+        s.cache_prefetch("v", np.arange(64), window=1)
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            st = s.tiering_stats()
+            if st["cache_fills"] + st["cache_fill_failures"] >= 1 \
+                    and s.async_pending() == 0:
+                break
+            _time.sleep(0.01)
+        assert s.tiering_stats()["cache_fills"] >= 1
+        # The fill's GetBatch leg recorded NO histogram sample.
+        assert s.metrics_stats()["ops_recorded"] == before
+        s.tier_configure(0)
+
+
+def test_metrics_reset_never_fakes_a_breach():
+    """metrics_reset() drops the cumulative counters BELOW the SLO
+    baselines — the next window must read as restarted-at-zero, never
+    as a wrapped ~2^64-count window firing a garbage breach (review
+    finding, pinned)."""
+    with DDStore(backend="local") as s:
+        s.add("v", np.zeros((ROWS, DIM), np.float32))
+        s.get_batch("v", np.arange(64))
+        s.set_tenant_slos("p99:1s")  # far above any local memcpy
+        s.get_batch("v", np.arange(64))
+        s.metrics_reset()            # counters fall below the baseline
+        assert s.evaluate_slos() == []
+        # The monitor keeps working cleanly after the reset.
+        s.get_batch("v", np.arange(64))
+        br = s.evaluate_slos()
+        assert br == [] and s.slo_stats()["breaches"] == 0
+
+
+def test_long_tenant_label_interns_once():
+    """A label past the 47-byte slot cap matches its truncated slot on
+    every lookup (one interned slot, no per-op duplicates) and a
+    raw-capi label carrying the CSV separator folds into slot 0
+    (review findings, pinned)."""
+    with DDStore(backend="local") as s:
+        rec = s._native.metrics_record
+        long = "t" * 80
+        for _ in range(8):
+            rec(0, 0, -1, long, 1000, 1)
+        st = s.metrics_stats()
+        assert st["tenants"] == 2, st       # "" + ONE truncated slot
+        assert st["tenant_overflow"] == 0
+        names = s._native.metrics_tenants()
+        assert names == ["", "t" * 47]
+        rec(0, 0, -1, "a,b", 1000, 1)       # CSV-hostile label
+        st = s.metrics_stats()
+        assert st["tenants"] == 2            # folded, not interned
+        assert st["tenant_overflow"] == 1
+        assert s._native.metrics_tenants() == ["", "t" * 47]
+
+
+def test_prometheus_label_escaping():
+    """Backslash/quote in a label value must be escaped or the scraper
+    rejects the whole scrape (review finding, pinned). Synthetic cell:
+    the validated entry points reject such labels, but the exporter
+    must be safe for any snapshot it is handed."""
+    c = np.zeros(1, dtype=METRICS_CELL_DTYPE)
+    c[0]["cls"], c[0]["route"], c[0]["peer"] = 0, 0, -1
+    c[0]["tenant"] = b'a"b\\c'
+    c[0]["count"] = 1
+    c[0]["lat"][4] = 1
+    text = obs.prometheus_text(c)
+    assert 'tenant="a\\"b\\\\c"' in text, text
+
+
+def test_slo_spec_parsing():
+    with DDStore(backend="local") as s:
+        s.set_tenant_slos("a=p99:5ms,b=p50:200us,p90:1s")
+        assert s.slo_stats()["rules"] == 3
+        s.set_tenant_slos("")  # clears
+        assert s.slo_stats()["rules"] == 0
+        with pytest.raises(DDStoreError):
+            s.set_tenant_slos("nonsense")
+        with pytest.raises(DDStoreError):
+            s.set_tenant_slos("a=p99:5parsecs")
+
+
+# -- exporters / CLI ----------------------------------------------------------
+
+def test_prometheus_line_format_golden():
+    """The exposition format is a contract (scrapers parse it): pin
+    the exact lines for a two-sample cell."""
+    with DDStore(backend="local") as s:
+        rec = s._native.metrics_record
+        rec(1, METRICS_ROUTE_CODES["tcp"], 2, "eval", 1500, 10)
+        rec(1, METRICS_ROUTE_CODES["tcp"], 2, "eval", 3000, 1)
+        text = obs.prometheus_text(s.metrics_snapshot())
+    labels = 'class="get_batch",route="tcp",peer="2",tenant="eval"'
+    for line in [
+        "# TYPE ddstore_op_latency_seconds histogram",
+        f'ddstore_op_latency_seconds_bucket{{{labels},le="2.048e-06"}} 1',
+        f'ddstore_op_latency_seconds_bucket{{{labels},le="4.096e-06"}} 2',
+        f'ddstore_op_latency_seconds_bucket{{{labels},le="+Inf"}} 2',
+        # Full ns precision, never %g: a 6-sig-digit sum stops moving
+        # between scrapes on long-lived stores (review finding).
+        f"ddstore_op_latency_seconds_sum{{{labels}}} 0.000004500",
+        f"ddstore_op_latency_seconds_count{{{labels}}} 2",
+        f"ddstore_op_bytes_total{{{labels}}} 11",
+    ]:
+        assert line in text.splitlines(), (line, text)
+
+
+def test_metrics_merge_and_diff_units():
+    a = np.zeros(1, dtype=METRICS_CELL_DTYPE)
+    a[0]["cls"], a[0]["route"], a[0]["peer"] = 1, 1, -1
+    a[0]["count"], a[0]["lat_sum_ns"] = 2, 3000
+    a[0]["lat"][10] = 2
+    b = a.copy()
+    b[0]["count"], b[0]["lat_sum_ns"] = 5, 9000
+    b[0]["lat"][10] = 4
+    b[0]["lat"][12] = 1
+    merged = obs.merge_metrics([a, b])
+    assert int(merged[0]["count"]) == 7
+    assert int(merged[0]["lat"][10]) == 6
+    delta = obs.diff_metrics(a, b)
+    assert int(delta[0]["count"]) == 3
+    assert int(delta[0]["lat"][10]) == 2 and int(delta[0]["lat"][12]) == 1
+    # Identical snapshots delta to nothing.
+    assert len(obs.diff_metrics(b, b)) == 0
+    js = obs.metrics_json(merged)
+    assert js["cells"]["get_batch|tcp|-1|"]["count"] == 7
+
+
+def test_obs_cli_latency_top_metrics(tmp_path, capsys):
+    """The CLI report paths: `latency` over a saved TRACE dump (the
+    obs.save_load gap this PR closes), `top` and `metrics` over saved
+    histogram snapshots."""
+    from ddstore_tpu.obs.__main__ import main
+
+    binding.trace_configure(1)
+    binding.trace_reset()
+
+    def body(s):
+        s.get_batch("v", np.arange(ROWS, ROWS + 32))
+        return s.metrics_snapshot()
+
+    cells = _run_pair(body)
+    tr = str(tmp_path / "trace.r0.npy")
+    obs.save_dump(tr, binding.trace_dump())
+    mt = str(tmp_path / "m.r0.npy")
+    obs.save_metrics(mt, cells)
+    binding.trace_configure(0)
+
+    assert main(["latency", tr]) == 0
+    out = capsys.readouterr().out
+    assert "class|route|peer" in out and "get_batch|tcp|-1" in out
+
+    assert main(["top", mt]) == 0
+    out = capsys.readouterr().out
+    assert "class|route|peer|tenant" in out
+    assert "get_batch|tcp|-1|" in out
+
+    assert main(["metrics", "--format", "prom", mt]) == 0
+    out = capsys.readouterr().out
+    assert "ddstore_op_latency_seconds_bucket" in out
+    assert main(["metrics", "--format", "json", mt]) == 0
+    out = capsys.readouterr().out
+    assert '"buckets": 44' in out

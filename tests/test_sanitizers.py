@@ -436,6 +436,82 @@ ts = [threading.Thread(target=tier_worker, args=(r, errs))
 assert not errs, errs
 for s in tier_stores.values():
     s._native.close()  # idempotent for the dead rank
+
+# ddmetrics paths under the sanitizer (ISSUE 14 satellite): lock-free
+# histogram hammering (CAS cell claims + relaxed increments from every
+# rank's op threads) CONCURRENT with snapshot/cluster pulls and SLO
+# evaluations reading the same cells, then a peer dying MID-PULL — the
+# control-plane pull must classify (never crash), the cluster view
+# assembles around the corpse, and async_pending()==0 after.
+os.environ["DDSTORE_REPLICATION"] = "1"
+os.environ["DDSTORE_RETRY_MAX"] = "2"
+METNAME = uuid.uuid4().hex
+MROWS, MDIM = 64, 32
+
+met_stores = {}
+met_ready = threading.Barrier(3)
+
+def met_worker(rank, errs):
+    try:
+        group = ThreadGroup(METNAME, rank, 3)
+        s = DDStore(group, backend="tcp")
+        met_stores[rank] = s
+        s.add("v", np.full((MROWS, MDIM), rank + 1, np.float32))
+        met_ready.wait()
+        if rank == 2:
+            # Hammer this rank's own histograms until rank 0 kills it:
+            # the dying registry must stay readable mid-pull.
+            for _ in range(200):
+                try:
+                    s.get_batch("v", np.arange(2 * MROWS,
+                                               2 * MROWS + 16))
+                except Exception:
+                    break
+            return
+        s.set_tenant_slos("p99:1ns")
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                s.metrics_snapshot()
+                s.cluster_metrics()
+                s.evaluate_slos()
+
+        rt = threading.Thread(target=reader)
+        rt.start()
+        rng = np.random.default_rng(rank)
+        try:
+            # Data reads stay on ranks 0-1's shards: the DEATH under
+            # test is a control-plane (metrics pull) event, not a data
+            # failover (R=1 here).
+            for it in range(40):
+                idx = np.sort(rng.choice(2 * MROWS, size=48,
+                                         replace=False))
+                got = s.get_batch("v", idx)
+                want = (idx // MROWS + 1).astype(np.float32)[:, None]
+                assert (got == want).all()
+                h = s.get_batch_async("v", idx)
+                h.wait()
+                if rank == 0 and it == 25:
+                    met_stores[2]._native.close()  # die mid-pulls
+                    s.mark_suspect(2)
+        finally:
+            stop.set()
+            rt.join()
+        assert s.async_pending() == 0, s.async_pending()
+        cells, dead = s.cluster_metrics()
+        assert len(cells) > 0
+    except Exception as e:  # noqa: BLE001
+        errs.append((rank, repr(e)))
+
+errs = []
+ts = [threading.Thread(target=met_worker, args=(r, errs))
+      for r in range(3)]
+[t.start() for t in ts]
+[t.join() for t in ts]
+assert not errs, errs
+for s in met_stores.values():
+    s._native.close()  # idempotent for the dead rank
 print("stress ok")
 """
 
