@@ -1638,6 +1638,333 @@ def slo_bench(world=4, num=16384, dim=64, batch=256, pairs=9):
     return out
 
 
+def gateway_bench(world=4, num=16384, dim=64, batch=256, readers=64):
+    """Serving-gateway overload bench (ISSUE 19 acceptance) over the
+    4-owner ThreadGroup TCP store:
+
+    1. oracle byte-identity FIRST (before any timing), read through a
+       gateway session with the gateway enabled;
+    2. multiplex leg: ~64 ephemeral reader threads attach with tenant
+       labels across all four rank gateways while ``ctrl-conndrop``
+       hard-closes control connections mid-session — every read must
+       come back byte-identical to the oracle with ZERO admission
+       give-ups, zero retry give-ups and zero data-plane injections
+       (the chaos is control-plane-only by construction);
+    3. overload leg: a protected tenant (p99 SLO rule) reads through
+       injected serve delays while unprotected over-share tenants
+       hammer the same store — admission must both DEFER and REJECT
+       (> 0 each) while the protected tenant's measured p99 stays
+       under its objective (no SLO breach);
+    4. reap leg: a reader is "SIGKILLed" (session attached with a
+       snapshot pin, then never renewed and never detached) and must
+       be reclaimed — session gone, pin released — within O(lease).
+
+    ``gateway_ok`` gates all of it. DDSTORE_CMA=0 forces the wire path
+    so the control-plane chaos and the serve-side delay injection are
+    real."""
+    import threading
+    import uuid
+
+    import numpy as np
+
+    from ddstore_tpu import DDStore, ThreadGroup, fault_configure
+    from ddstore_tpu import obs as _obs
+    from ddstore_tpu.binding import ERR_ADMISSION, DDStoreError
+
+    env = {"DDSTORE_CMA": "0"}
+    backup = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    out = {}
+    errors = []
+    name = uuid.uuid4().hex
+    rows = num // world
+
+    def shard_of(rank):
+        return np.random.default_rng(53 + rank).standard_normal(
+            (rows, dim)).astype(np.float32)
+
+    try:
+        def run_rank(rank):
+            g = ThreadGroup(name, rank, world)
+            with DDStore(g, backend="tcp") as s:
+                s.add("v", shard_of(rank))
+                # EVERY rank opens its gateway (the readers fan out
+                # across all four): long lease for the mux leg — under
+                # ctrl-conndrop every renewal may fail, and the leg
+                # finishes well inside one lease, so chaos cannot
+                # expire a live session out from under a reader (the
+                # REAP leg covers expiry, with a short lease).
+                s.gateway_configure(enabled=1, lease_ms=3000,
+                                    defer_ms=30, queue_cap=16,
+                                    admit_margin_pct=80)
+                s.barrier()
+                if rank == 0:
+                    _gateway_rank0(s, out, world, num, dim, batch,
+                                   readers, shard_of)
+                s.barrier()
+
+        def _gateway_rank0(s, out, world, num, dim, batch, readers,
+                           shard_of):
+            oracle = np.concatenate([shard_of(r) for r in range(world)])
+
+            # 1. Identity BEFORE timing, through a gateway session.
+            with s.gateway_session() as sess:
+                ver = np.random.default_rng(9).integers(0, num, 512)
+                np.testing.assert_array_equal(
+                    sess.get_batch("v", ver), oracle[ver])
+            out["gateway_identity_ok"] = True
+
+            # 2. Multiplex leg under ctrl-conndrop chaos. Arming
+            # resets every injector counter, so the post-leg
+            # fault_stats read absolute values — and it must happen
+            # BEFORE the disarm, which resets them again.
+            gw0 = s.gateway_stats()
+            fault_configure("ctrl-conndrop:0.25", 37)
+            mux_bad = []        # readers whose bytes diverged
+            mux_giveups = [0]   # admission give-ups across sessions
+            attach_fail = [0]   # sessions that never attached
+            lock = threading.Lock()
+
+            def reader(i):
+                rng = np.random.default_rng(1000 + i)
+                sess = None
+                # A dropped control connection refuses the attach with
+                # kErrTransport; the client's contract is to retry the
+                # attach, not to treat a shed control op as data loss.
+                for _ in range(8):
+                    try:
+                        sess = s.gateway_session(
+                            tenant=f"eph{i % 8}", target=i % world,
+                            seed=500 + i)
+                        break
+                    except DDStoreError:
+                        continue
+                if sess is None:
+                    with lock:
+                        attach_fail[0] += 1
+                    return
+                try:
+                    for _ in range(4):
+                        idx = rng.integers(0, num, batch)
+                        got = sess.get_batch("v", idx)
+                        if not np.array_equal(got, oracle[idx]):
+                            with lock:
+                                mux_bad.append(i)
+                            return
+                finally:
+                    st = sess.stats()
+                    with lock:
+                        mux_giveups[0] += st["admission_giveups"]
+                    sess.close()
+
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=reader, args=(i,))
+                  for i in range(readers)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(120)
+            mux_s = time.perf_counter() - t0
+            fs = s.fault_stats()
+            fault_configure("", 0)
+            hung = sum(t.is_alive() for t in ts)
+            gw = s.gateway_stats()
+            mux_bytes = (readers - attach_fail[0]) * 4 * batch * dim * 4
+            out.update({
+                "gateway_mux_readers": readers,
+                "gateway_mux_attach_failures": attach_fail[0],
+                "gateway_mux_s": round(mux_s, 3),
+                "gateway_mux_gbps": round(mux_bytes / mux_s / 1e9, 3),
+                "gateway_mux_attaches":
+                    gw["attaches"] - gw0["attaches"],
+                "gateway_ctrl_drops": fs["ctrl_injected"],
+                "gateway_retry_giveups": fs["retry_giveups"],
+                "gateway_mux_giveups": mux_giveups[0],
+            })
+            # Rank 0's own gateway only sees 1/4 of the attaches (the
+            # readers fan out across all four rank gateways); the
+            # client-side count is the complete one.
+            out["gateway_mux_ok"] = bool(
+                not mux_bad and hung == 0 and attach_fail[0] == 0
+                and mux_giveups[0] == 0
+                and out["gateway_ctrl_drops"] > 0
+                and out["gateway_retry_giveups"] == 0
+                and fs["injected_reset"] == 0
+                and fs["injected_trunc"] == 0)
+
+            # 3. Overload leg: protected tenant vs over-share tenants.
+            s.set_tenant_slos("prot=p99:250ms")
+            # margin 1% of the 250 ms objective = 2.5 ms effective
+            # admission threshold; the injected 10 ms serve delays on
+            # the protected tenant's reads guarantee predicted p99
+            # crosses it, deterministically shedding the over-share
+            # tenants while the objective itself holds with headroom.
+            s.gateway_configure(admit_margin_pct=1)
+            gw0 = s.gateway_stats()
+            prot = s.attach("prot")
+            dst = np.empty((batch, dim), np.float32)
+            warm = threading.Event()
+            done = threading.Event()
+            prot_bad = [False]
+            prot_reads = [0]
+            sheds = [0]
+
+            def prot_body():
+                rng = np.random.default_rng(77)
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    idx = rng.integers(0, num, batch)
+                    prot.get_batch("v", idx, out=dst)
+                    if not np.array_equal(dst, oracle[idx]):
+                        prot_bad[0] = True
+                    prot_reads[0] += 1
+                    if prot_reads[0] >= 2:
+                        warm.set()  # histogram populated: pressure on
+                    if done.is_set() and prot_reads[0] >= 12:
+                        return
+
+            def greedy_body(i):
+                sess = s.gateway_session(tenant=f"greedy{i}",
+                                         max_retries=2, seed=700 + i)
+                try:
+                    rng = np.random.default_rng(300 + i)
+                    deadline = time.monotonic() + 8
+                    for _ in range(10):
+                        if time.monotonic() > deadline:
+                            return
+                        idx = rng.integers(0, num, batch)
+                        try:
+                            sess.get_batch("v", idx)
+                        except DDStoreError as e:
+                            if e.code != ERR_ADMISSION:
+                                raise
+                            with lock:
+                                sheds[0] += 1
+                finally:
+                    sess.close()
+
+            fault_configure("delay:0.5:10", 31,
+                            ranks=list(range(1, world)))
+            try:
+                pt = threading.Thread(target=prot_body)
+                pt.start()
+                if not warm.wait(30):
+                    raise RuntimeError("protected tenant never warmed "
+                                       "the admission histogram")
+                gts = [threading.Thread(target=greedy_body, args=(i,))
+                       for i in range(8)]
+                for t in gts:
+                    t.start()
+                for t in gts:
+                    t.join(60)
+                done.set()
+                pt.join(60)
+            finally:
+                fault_configure("", 0)
+            breaches = s.evaluate_slos()
+            gw = s.gateway_stats()
+            deferred = gw["deferred"] - gw0["deferred"]
+            rejected = gw["rejected"] - gw0["rejected"]
+            # Measured protected p99 straight from the always-on
+            # histograms (summed over routes/peers for tenant "prot").
+            lat = None
+            for c in s.metrics_snapshot():
+                if c["tenant"] == b"prot":
+                    lat = c["lat"] if lat is None else lat + c["lat"]
+            p99_ms = _obs.hist_percentile(lat, 99) / 1e6 \
+                if lat is not None else -1.0
+            out.update({
+                "gateway_deferred": int(deferred),
+                "gateway_rejected": int(rejected),
+                "gateway_overshare_sheds": sheds[0],
+                "gateway_prot_reads": prot_reads[0],
+                "gateway_prot_p99_ms": round(p99_ms, 3),
+                "gateway_prot_slo_ms": 250.0,
+                "gateway_prot_breaches": len(
+                    [b for b in breaches if b["tenant"] == "prot"]),
+                "gateway_retry_after_ms":
+                    gw["last_retry_after_ms"],
+            })
+            out["gateway_overload_ok"] = bool(
+                deferred > 0 and rejected > 0
+                and not prot_bad[0]
+                and out["gateway_prot_breaches"] == 0
+                and 0 < p99_ms < 250.0)
+            s.set_tenant_slos("")
+            s.gateway_configure(admit_margin_pct=80)
+
+            # 4. Reap leg: SIGKILLed reader (never renews, never
+            # detaches) reclaimed within O(lease).
+            lease_ms = 250
+            s.gateway_configure(lease_ms=lease_ms)
+            snap0 = s.snapshot_stats()
+            exp0 = s.gateway_stats()["expired"]
+            s._native.gateway_attach(target=0, tenant="dead",
+                                     with_snapshot=True)
+            pinned = s.snapshot_stats()["active_snapshots"] \
+                > snap0["active_snapshots"]
+            t0 = time.monotonic()
+            reaped_in = -1.0
+            while time.monotonic() - t0 < 10 * lease_ms / 1e3:
+                s.gateway_reap()
+                snap = s.snapshot_stats()
+                if s.gateway_stats()["sessions"] == 0 and \
+                        snap["active_snapshots"] == \
+                        snap0["active_snapshots"]:
+                    reaped_in = time.monotonic() - t0
+                    break
+                time.sleep(0.02)
+            out.update({
+                "gateway_reap_pinned": bool(pinned),
+                "gateway_reap_s": round(reaped_in, 3),
+                "gateway_reap_lease_ms": lease_ms,
+                "gateway_reap_expired":
+                    s.gateway_stats()["expired"] - exp0,
+            })
+            # Lease expiry releases the pin through the session's own
+            # release path (the stale-pin reaper and its
+            # reclaimed_pins gauge are the backstop for pins with NO
+            # session, covered by the pin-TTL test): the proof here is
+            # the expiry count plus active_snapshots back to baseline.
+            out["gateway_reap_ok"] = bool(
+                pinned and 0 <= reaped_in <= 8 * lease_ms / 1e3
+                and out["gateway_reap_expired"] >= 1)
+
+            out["gateway_ok"] = bool(
+                out.get("gateway_identity_ok")
+                and out.get("gateway_mux_ok")
+                and out.get("gateway_overload_ok")
+                and out.get("gateway_reap_ok"))
+
+        def body(rank):
+            try:
+                run_rank(rank)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        ts = [threading.Thread(target=body, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(240)
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in ts):
+            raise RuntimeError("gateway_bench rank thread hung past "
+                               "its 240 s join")
+    finally:
+        from ddstore_tpu import fault_configure as _fc
+
+        _fc("", 0)
+        for k, v in backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def tenants_bench(world=4, num=16384, dim=64, batch=256, epochs=8):
     """Multi-tenant service A/B (ISSUE 9 acceptance): two concurrent
     attached jobs over one 4-owner ThreadGroup store.
@@ -3600,6 +3927,29 @@ def _phase_slo():
     return o
 
 
+def _phase_gateway():
+    o = gateway_bench()
+    print(f"# gateway (serving): {o.get('gateway_mux_readers', 0)} "
+          f"ephemeral readers over 4 gateways under ctrl-conndrop "
+          f"({o.get('gateway_ctrl_drops', 0)} control drops) -> "
+          f"{'byte-identical' if o.get('gateway_mux_ok') else 'DIVERGED/GAVE UP'}, "
+          f"{o.get('gateway_mux_gbps', 0):.2f} GB/s aggregate; "
+          f"overload: {o.get('gateway_deferred', 0)} deferred + "
+          f"{o.get('gateway_rejected', 0)} rejected "
+          f"({o.get('gateway_overshare_sheds', 0)} over-share sheds, "
+          f"retry-after {o.get('gateway_retry_after_ms', 0)} ms) while "
+          f"protected p99 {o.get('gateway_prot_p99_ms', 0):.1f}ms held "
+          f"under its {o.get('gateway_prot_slo_ms', 0):.0f}ms SLO "
+          f"({o.get('gateway_prot_breaches', 0)} breaches); SIGKILLed "
+          f"session reaped in {o.get('gateway_reap_s', -1):.2f}s "
+          f"(lease {o.get('gateway_reap_lease_ms', 0)} ms, "
+          f"{o.get('gateway_reap_expired', 0)} lease(s) expired, "
+          f"pin released) -> "
+          f"{'OK' if o.get('gateway_ok') else 'NOT OK'}",
+          file=sys.stderr)
+    return o
+
+
 def _phase_failover():
     o = failover_bench()
     print(f"# failover (R=2): owner SIGKILLed INSIDE an epoch fence -> "
@@ -3675,7 +4025,7 @@ _PHASES = (("local", _phase_local), ("tcp", _phase_tcp),
            ("failover", _phase_failover), ("tenants", _phase_tenants),
            ("trace", _phase_trace), ("integrity", _phase_integrity),
            ("tiered", _phase_tiered), ("slo", _phase_slo),
-           ("soak", _phase_soak))
+           ("gateway", _phase_gateway), ("soak", _phase_soak))
 
 
 def _kill_group(proc):
@@ -3784,6 +4134,11 @@ def main():
     # breach leg, and metrics-off/on pairs; same own-cap pattern.
     slo_timeout = float(os.environ.get(
         "DDSTORE_SLO_PHASE_TIMEOUT_S", 300))
+    # The gateway phase runs 64 reader threads under control-plane
+    # chaos plus a deliberate overload (admission backoff in its wall
+    # time); same own-cap pattern.
+    gateway_timeout = float(os.environ.get(
+        "DDSTORE_GATEWAY_PHASE_TIMEOUT_S", 300))
     # The lanes A/B runs three full store lifetimes (1-lane, N-lane,
     # autotuned) over the wire path; its own cap (soak/ppsched/chaos
     # pattern) keeps a slow run from eating a device phase's budget.
@@ -3818,7 +4173,7 @@ def main():
                      if n not in ("local", "tcp", "readahead", "lanes",
                                   "sched", "chaos", "failover",
                                   "tenants", "trace", "integrity",
-                                  "tiered", "slo", "soak")}
+                                  "tiered", "slo", "gateway", "soak")}
     probe = None
     device_ok = True
     if os.environ.get("DDSTORE_BENCH_SKIP_PROBE") != "1":
@@ -3930,6 +4285,7 @@ def main():
                              "integrity": integrity_timeout,
                              "tiered": tiered_timeout,
                              "slo": slo_timeout,
+                             "gateway": gateway_timeout,
                              "lanes": lanes_timeout,
                              "sched": sched_timeout}.get(name, timeout)
             try:

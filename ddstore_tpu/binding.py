@@ -237,6 +237,27 @@ def _load() -> ctypes.CDLL:
                                      ctypes.c_int]
     lib.dds_slo_stats.restype = ctypes.c_int
     lib.dds_slo_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_gateway_configure.restype = ctypes.c_int
+    lib.dds_gateway_configure.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_long, ctypes.c_long,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_long]
+    lib.dds_gateway_attach.restype = _i64
+    lib.dds_gateway_attach.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_char_p, ctypes.c_int,
+                                       _i64]
+    lib.dds_gateway_renew.restype = ctypes.c_int
+    lib.dds_gateway_renew.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      _i64]
+    lib.dds_gateway_detach.restype = ctypes.c_int
+    lib.dds_gateway_detach.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       _i64]
+    lib.dds_gateway_drain.restype = ctypes.c_int
+    lib.dds_gateway_drain.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.dds_gateway_reap.restype = ctypes.c_int
+    lib.dds_gateway_reap.argtypes = [ctypes.c_void_p]
+    lib.dds_gateway_stats.restype = ctypes.c_int
+    lib.dds_gateway_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_trace_configure.restype = ctypes.c_int
     lib.dds_trace_configure.argtypes = [ctypes.c_int, ctypes.c_long]
     lib.dds_trace_enabled.restype = ctypes.c_int
@@ -274,6 +295,7 @@ def _load() -> ctypes.CDLL:
 
 # Error codes tested by the Python-side classification (mirrors
 # dds::ErrorCode; see native/store.h).
+ERR_NOT_FOUND = -2   # unknown variable / expired gateway lease token
 ERR_TRANSPORT = -6   # transient-class transport failure
 ERR_PEER_LOST = -10  # transient-retry budget exhausted: owner presumed
 #                      dead — fatal, invoke elastic.recover
@@ -287,6 +309,11 @@ ERR_CORRUPT = -12    # data integrity failure (DDSTORE_VERIFY=1): the
 #                      ERR_QUOTA (nothing died; the store's bytes may
 #                      be fine and only one holder rotten — inspect
 #                      integrity_stats()["last_corrupt_peer"])
+ERR_ADMISSION = -13  # serving-gateway admission refusal: over-share
+#                      tenant deferred past its window (or the rank is
+#                      draining) — non-fatal, defer-not-peer-lost; the
+#                      gateway's last_retry_after_ms stat carries the
+#                      back-off hint (seeded-jitter retry, then give up)
 
 
 class DDStoreError(RuntimeError):
@@ -363,7 +390,8 @@ TRACE_TYPES = {
     18: "lane_budget_rotate", 19: "flight", 20: "failover",
     21: "verify_fail", 22: "scrub", 23: "barrier", 24: "barrier_done",
     25: "barrier_abort", 26: "cache_fill", 27: "cache_hit",
-    28: "cache_evict", 29: "slo_breach",
+    28: "cache_evict", 29: "slo_breach", 30: "gw_session",
+    31: "gw_shed",
 }
 #: name -> code view of :data:`TRACE_TYPES` (Python-side emitters).
 TRACE_TYPE_CODES = {v: k for k, v in TRACE_TYPES.items()}
@@ -375,7 +403,8 @@ TRACE_OP_CLASSES = {0: "get", 1: "get_batch", 2: "read_runs",
 #: flight-recorder trigger codes (trace.h FlightReason).
 TRACE_FLIGHT_REASONS = {1: "peer_lost", 2: "quota", 3: "window_giveup",
                         4: "suspect", 5: "manual", 6: "corrupt",
-                        7: "barrier_abort", 8: "slo_breach"}
+                        7: "barrier_abort", 8: "slo_breach",
+                        9: "shed_storm"}
 
 #: dict keys of :func:`trace_stats`, in native layout order (keep in
 #: sync with capi dds_trace_stats / trace::Stats).
@@ -424,6 +453,17 @@ SLO_STAT_KEYS = ("rules", "evaluations", "breaches", "window_ms",
                  "last_breach_tenant_slot")
 #: the gauge subset of :data:`SLO_STAT_KEYS` (never delta'd).
 SLO_GAUGE_KEYS = ("rules", "window_ms", "last_breach_tenant_slot")
+
+#: dict keys of ``NativeStore.gateway_stats`` in native layout order
+#: (keep in sync with capi dds_gateway_stats / gw::Gateway::Stats).
+#: attaches..rejected and drain_sheds are monotone; the rest gauges.
+GATEWAY_STAT_KEYS = ("enabled", "sessions", "attaches", "detaches",
+                     "expired", "renewals", "admitted", "deferred",
+                     "rejected", "drain_sheds", "draining", "inflight",
+                     "deferred_now", "last_retry_after_ms")
+#: the gauge subset of :data:`GATEWAY_STAT_KEYS` (never delta'd).
+GATEWAY_GAUGE_KEYS = ("enabled", "sessions", "draining", "inflight",
+                      "deferred_now", "last_retry_after_ms")
 
 
 def trace_configure(enabled: int, ring_events: int = -1) -> None:
@@ -841,13 +881,86 @@ class NativeStore:
 
     def snapshot_stats(self) -> dict:
         """This rank's snapshot gauges: active pins, kept shard
-        versions and their RAM cost."""
+        versions and their RAM cost, plus the monotone count of pins
+        reclaimed by the stale-pin reaper (TTL / dead owner)."""
         arr = (ctypes.c_int64 * 4)()
         _check(self._lib.dds_snapshot_stats(self._h, arr),
                "snapshot_stats")
         return {"active_snapshots": int(arr[0]),
                 "kept_versions": int(arr[1]),
-                "kept_bytes": int(arr[2])}
+                "kept_bytes": int(arr[2]),
+                "reclaimed_pins": int(arr[3])}
+
+    # -- serving gateway ---------------------------------------------------
+
+    def gateway_configure(self, enabled: int = -1, lease_ms: int = -1,
+                          defer_ms: int = -1, queue_cap: int = -1,
+                          admit_margin_pct: int = -1,
+                          lane_share: int = -1,
+                          pin_ttl_ms: int = -1) -> None:
+        """Runtime gateway (re)configuration; -1 keeps each field.
+        ``enabled=1`` clears a previous drain and (re)arms the lease
+        reaper; ``pin_ttl_ms`` arms stranded-pin reclaim even with the
+        gateway off. Load-time knobs: ``DDSTORE_GATEWAY`` /
+        ``DDSTORE_GW_*`` / ``DDSTORE_SNAP_PIN_TTL_MS``."""
+        _check(self._lib.dds_gateway_configure(
+            self._h, int(enabled), int(lease_ms), int(defer_ms),
+            int(queue_cap), int(admit_margin_pct), int(lane_share),
+            int(pin_ttl_ms)), "gateway_configure")
+
+    def gateway_attach(self, target: int = -1, tenant: str = "",
+                       with_snapshot: bool = False,
+                       quota_bytes: int = 0) -> int:
+        """Attach an ephemeral reader session on ``target``'s gateway
+        (< 0 = this rank) and return the session token. The lease
+        must be renewed at ~lease/3 or its pins/quota/lane share are
+        reaped."""
+        token = int(self._lib.dds_gateway_attach(
+            self._h, int(target), tenant.encode(),
+            1 if with_snapshot else 0, int(quota_bytes)))
+        if token < 0:
+            raise DDStoreError(token, f"gateway_attach({tenant!r})")
+        return token
+
+    def gateway_renew(self, token: int, target: int = -1) -> None:
+        """Lease heartbeat; raises ``ERR_NOT_FOUND`` after expiry."""
+        _check(self._lib.dds_gateway_renew(self._h, int(target),
+                                           int(token)),
+               f"gateway_renew({token})")
+
+    def gateway_detach(self, token: int, target: int = -1) -> None:
+        """Graceful goodbye: releases the lease's snapshot pins, quota
+        reservation and (last-of-tenant) lane share."""
+        _check(self._lib.dds_gateway_detach(self._h, int(target),
+                                            int(token)),
+               f"gateway_detach({token})")
+
+    def gateway_drain(self, deadline_ms: int = 1000) -> bool:
+        """Stop admitting, wait up to ``deadline_ms`` for in-flight
+        reads, shed the rest with ``ERR_ADMISSION``. True when the
+        gateway went quiet inside the deadline."""
+        rc = int(self._lib.dds_gateway_drain(self._h, int(deadline_ms)))
+        if rc == 0:
+            return True
+        if rc == ERR_TRANSPORT:
+            return False
+        raise DDStoreError(rc, "gateway_drain")
+
+    def gateway_reap(self) -> int:
+        """One synchronous lease/pin reap pass (the deterministic test
+        hook for the background reaper). Returns reclaimed pin count."""
+        rc = int(self._lib.dds_gateway_reap(self._h))
+        if rc < 0:
+            raise DDStoreError(rc, "gateway_reap")
+        return rc
+
+    def gateway_stats(self) -> dict:
+        """Gateway counters (:data:`GATEWAY_STAT_KEYS`)."""
+        arr = (ctypes.c_int64 * 16)()
+        _check(self._lib.dds_gateway_stats(self._h, arr),
+               "gateway_stats")
+        return dict(zip(GATEWAY_STAT_KEYS,
+                        list(arr)[:len(GATEWAY_STAT_KEYS)]))
 
     # -- ddmetrics: live latency histograms + SLO monitor -----------------
 

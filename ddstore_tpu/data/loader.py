@@ -19,6 +19,8 @@ called out in §7 as the anti-pattern to fix). Here the loader:
 from __future__ import annotations
 
 import itertools
+import os
+import random
 import threading
 import time
 from collections import deque
@@ -27,7 +29,7 @@ from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
-from ..binding import ERR_PEER_LOST, DDStoreError
+from ..binding import ERR_ADMISSION, ERR_PEER_LOST, DDStoreError
 from ..utils.metrics import PipelineMetrics
 from ..utils.profile import annotate
 
@@ -206,6 +208,12 @@ class DeviceLoader:
             # replan trigger per breached tenant (inert with no SLOs
             # configured).
             self.metrics.set_slo_source(store.slo_summary)
+        if store is not None and hasattr(store, "gateway_stats"):
+            # Serving gateway: summary()["gateway"] carries this
+            # epoch's admission/lease deltas (admitted/deferred/
+            # rejected, attach/expiry churn) whenever the gateway is
+            # armed (absent from the summary when off).
+            self.metrics.set_gateway_source(store.gateway_stats)
         if store is not None and hasattr(store, "lane_bytes"):
             # Per-lane byte deltas land in summary()["bytes_moved"]
             # (lane_bytes / tcp_lanes_used / lane_utilization): whether
@@ -282,6 +290,13 @@ class DeviceLoader:
         # double-count the degradation event).
         self._ra_degraded = threading.Event()
         self._ra_degrade_mu = threading.Lock()
+        # Gateway admission deferrals back off with seeded jitter (the
+        # same reproducibility contract as the native retry ladder's
+        # DDSTORE_FAULT_SEED); the lock serializes racing prefetch
+        # workers over the shared PRNG.
+        self._admission_rng = random.Random(
+            int(os.environ.get("DDSTORE_FAULT_SEED", "0") or 0))
+        self._admission_mu = threading.Lock()
 
     def _readahead_usable(self) -> bool:
         store = getattr(self.dataset, "store", None)
@@ -410,6 +425,18 @@ class DeviceLoader:
                 return
             yield np.asarray(idx, dtype=np.int64)
 
+    def _admission_backoff(self, e: BaseException) -> None:
+        """Honor a serving-gateway retry-after hint: one bounded,
+        seeded-jitter sleep before this batch falls to the per-batch
+        path. Deferral is flow control, not failure — no ladder latch,
+        no replan. Jitter is drawn from a loader-local PRNG seeded off
+        ``DDSTORE_FAULT_SEED`` so chaos runs stay reproducible."""
+        hint_ms = int(getattr(e, "retry_after_ms", 0) or 0)
+        sleep_s = min(max(hint_ms, 1), 1000) / 1000.0
+        with self._admission_mu:
+            sleep_s *= 0.5 + self._admission_rng.random()
+        time.sleep(sleep_s)
+
     def _degrade_readahead(self, e: BaseException) -> None:
         """Latch the per-epoch readahead degradation (idempotent across
         racing workers — first failure wins) and record the reason
@@ -446,19 +473,32 @@ class DeviceLoader:
                     if self.sched is not None:
                         self.sched.on_degradation("peer_lost")
                     raise
-                if self.collective_fallback_reason is None:
-                    self.collective_fallback_reason = \
-                        f"degraded mid-epoch: {e}"
-                self.metrics.add_fault_event(collective_batch_fallbacks=1)
-                if self.sched is not None:
-                    self.sched.on_degradation("collective")
-                if ra is not None:
-                    # The engine raised before any window delivery for
-                    # this seq (batch_rows fails before marking
-                    # delivered), so the host path must not consult it
-                    # either — it would re-raise the same error.
-                    self._degrade_readahead(e)
-                    ra = None
+                if e.code == ERR_ADMISSION:
+                    # Defer, not peer-lost: the serving gateway shed
+                    # this read to protect another tenant's SLO.
+                    # Nothing died and nothing is broken — honor the
+                    # retry-after hint, retry THIS batch per-batch, and
+                    # leave the epoch's readahead/collective machinery
+                    # armed (no degradation latch, no replan trigger).
+                    self.metrics.add_fault_event(
+                        admission_deferred_batches=1)
+                    self._admission_backoff(e)
+                    ra = None  # this batch only; the latch stays clear
+                else:
+                    if self.collective_fallback_reason is None:
+                        self.collective_fallback_reason = \
+                            f"degraded mid-epoch: {e}"
+                    self.metrics.add_fault_event(
+                        collective_batch_fallbacks=1)
+                    if self.sched is not None:
+                        self.sched.on_degradation("collective")
+                    if ra is not None:
+                        # The engine raised before any window delivery
+                        # for this seq (batch_rows fails before marking
+                        # delivered), so the host path must not consult
+                        # it either — it would re-raise the same error.
+                        self._degrade_readahead(e)
+                        ra = None
         with self.metrics.fetch.timed(), annotate("ddstore:fetch"):
             batch = None
             if ra is not None:
@@ -477,7 +517,16 @@ class DeviceLoader:
                         if self.sched is not None:
                             self.sched.on_degradation("peer_lost")
                         raise
-                    self._degrade_readahead(e)
+                    if e.code == ERR_ADMISSION:
+                        # Defer, not peer-lost: back off per the
+                        # gateway's hint and serve this one batch from
+                        # the host path — the readahead engine stays
+                        # armed for the rest of the epoch.
+                        self.metrics.add_fault_event(
+                            admission_deferred_batches=1)
+                        self._admission_backoff(e)
+                    else:
+                        self._degrade_readahead(e)
             if batch is None:
                 batch = (self.dataset(idx) if callable(self.dataset)
                          else self.dataset.fetch(idx))
@@ -590,6 +639,7 @@ class DeviceLoader:
             # closes the observe->react loop by replanning the
             # breached tenant's routes/lanes/shares.
             self._check_slos()
+            self._check_admission_pressure()
             self.metrics.epoch_end()
 
     def _check_slos(self) -> None:
@@ -608,6 +658,22 @@ class DeviceLoader:
         if self.sched is not None:
             for b in breaches:
                 self.sched.on_degradation(f"slo:{b['tenant']}")
+
+    def _check_admission_pressure(self) -> None:
+        """Feed the epoch's gateway deferred/rejected deltas to the
+        planner as defer pressure (one replan, not one per deferral —
+        admission events inside the epoch only sleep and retry). Inert
+        with the gateway off; never fails the epoch."""
+        if self.sched is None:
+            return
+        try:
+            gw = self.metrics.gateway_summary()
+            deferred = int(gw.get("deferred", 0))
+            rejected = int(gw.get("rejected", 0))
+        except Exception:
+            return  # observability must never fail an epoch
+        if deferred or rejected:
+            self.sched.on_admission_pressure(deferred, rejected)
 
     def __len__(self) -> int:
         n = len(self.sampler)

@@ -223,6 +223,20 @@ def recover(store: DDStore, root: str,
                          "full-world store")
     if timeout is None:
         timeout = _default_timeout()
+    # Serving gateway: quiesce ephemeral readers BEFORE the topology
+    # swap. Drain stops admitting, lets in-flight reads finish under a
+    # short deadline, and sheds the rest with ERR_ADMISSION
+    # (defer-not-peer-lost: sessions back off on the retry-after hint
+    # and resume) — instead of their reads dying on re-pointed sockets
+    # mid-swap and masquerading as a second failure. Re-enabled after
+    # the post-recovery barrier proves the new world.
+    gw_draining = False
+    try:
+        if store.gateway_stats().get("enabled", 0):
+            store.gateway_drain(deadline_ms=1000)
+            gw_draining = True
+    except Exception:  # noqa: BLE001 — a gateway-less store recovers fine
+        pass
     gen = store._generation + 1
     group = FileGroup(_gen_dir(root, gen), store.rank, store.world, timeout)
     endpoints = group.allgather(
@@ -261,6 +275,11 @@ def recover(store: DDStore, root: str,
     try:
         store.barrier()
         _restore_replication(store)
+        if gw_draining:
+            # New world proven end-to-end: reopen for ephemeral
+            # readers (clears the sticky drain flag; deferred sessions
+            # re-admit on their next backoff retry).
+            store.gateway_configure(enabled=1)
     except DDStoreError as e:
         raise DDStoreError(
             e.code,

@@ -408,10 +408,73 @@ int dds_snapshot_release(dds_handle* h, int64_t snap_id) {
   return h->store->SnapshotRelease(snap_id);
 }
 
-// [active_snapshots, kept_versions, kept_bytes, 0] on THIS rank.
+// [active_snapshots, kept_versions, kept_bytes, reclaimed_pins] on
+// THIS rank.
 int dds_snapshot_stats(dds_handle* h, int64_t out[4]) {
   if (!h || !out) return dds::kErrInvalidArg;
   h->store->SnapshotCounters(out);
+  return dds::kOk;
+}
+
+// -- serving gateway ---------------------------------------------------------
+
+// Runtime gateway (re)configuration; -1 keeps each numeric field.
+// enabled >= 1 also clears a previous drain and (re)arms the lease
+// reaper; pin_ttl_ms arms stranded-pin reclaim even with the gateway
+// off.
+int dds_gateway_configure(dds_handle* h, int enabled, long lease_ms,
+                          long defer_ms, int queue_cap,
+                          int admit_margin_pct, int lane_share,
+                          long pin_ttl_ms) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->ConfigureGateway(enabled, lease_ms, defer_ms,
+                                    queue_cap, admit_margin_pct,
+                                    lane_share, pin_ttl_ms);
+}
+
+// Attach an ephemeral reader session on `target`'s gateway (target ==
+// this rank or < 0 attaches locally). Returns a positive session
+// token, or a negative ErrorCode.
+int64_t dds_gateway_attach(dds_handle* h, int target, const char* tenant,
+                           int with_snapshot, int64_t quota_bytes) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GatewayAttachTo(target, tenant ? tenant : "",
+                                   with_snapshot, quota_bytes);
+}
+
+// Lease heartbeat: kOk, or kErrNotFound after expiry (re-attach).
+int dds_gateway_renew(dds_handle* h, int target, int64_t token) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GatewayRenewTo(target, token);
+}
+
+// Graceful goodbye: releases the lease's pins/quota/lane share.
+int dds_gateway_detach(dds_handle* h, int target, int64_t token) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GatewayDetachTo(target, token);
+}
+
+// Stop admitting, wait up to deadline_ms for in-flight reads, shed
+// the rest with kErrAdmission. kOk when the gateway went quiet.
+int dds_gateway_drain(dds_handle* h, long deadline_ms) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GatewayDrain(deadline_ms);
+}
+
+// One synchronous lease/pin reap pass (the deterministic test hook for
+// the background reaper). Returns the number of stale pins reclaimed.
+int dds_gateway_reap(dds_handle* h) {
+  if (!h) return dds::kErrInvalidArg;
+  return h->store->GatewayReap();
+}
+
+// Layout (keep in sync with binding.py GATEWAY_STAT_KEYS):
+// [enabled, sessions, attaches, detaches, expired, renewals, admitted,
+//  deferred, rejected, drain_sheds, draining, inflight, deferred_now,
+//  last_retry_after_ms, 0, 0].
+int dds_gateway_stats(dds_handle* h, int64_t out[16]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->GatewayStats(out);
   return dds::kOk;
 }
 

@@ -43,6 +43,9 @@ bool ParseKind(const std::string& tok, FaultKind* kind, int* dflt_ms) {
   } else if (tok == "corrupt") {
     *kind = FaultKind::kCorrupt;
     *dflt_ms = 8;  // bytes to flip per injected event
+  } else if (tok == "conndrop") {
+    *kind = FaultKind::kConnDrop;
+    *dflt_ms = 0;
   } else {
     return false;
   }
@@ -98,6 +101,10 @@ int FaultInjector::Configure(const std::string& spec, uint64_t seed,
     if (ctrl &&
         (kind == FaultKind::kTrunc || kind == FaultKind::kCorrupt))
       return kErrInvalidArg;
+    // conndrop is the mirror restriction: it hard-closes a SESSION
+    // control connection, which the data plane does not have — only
+    // "ctrl-conndrop:p" is a valid arm.
+    if (!ctrl && kind == FaultKind::kConnDrop) return kErrInvalidArg;
     size_t c2 = entry.find(':', c1 + 1);
     char* endp = nullptr;
     const std::string pstr =
@@ -188,6 +195,7 @@ FaultDecision FaultInjector::Draw(int rank) {
         case FaultKind::kCorrupt:
           c_corrupt_.fetch_add(1, std::memory_order_relaxed);
           break;
+        case FaultKind::kConnDrop:  // ctrl-only by Configure; unreachable
         case FaultKind::kNone:
           break;
       }

@@ -60,6 +60,13 @@ enum class FaultKind : int {
             // the frame is well-formed and no transport error fires,
             // so ONLY checksum verification (DDSTORE_VERIFY=1) can
             // catch it. Spec arm: "corrupt:p[:nbytes]".
+  kConnDrop,// hard-close the gateway/control connection mid-session
+            // (shutdown both ways BEFORE serving, like kReset, but a
+            // separately armable arm so chaos runs can target session
+            // control without touching the data-plane reset budget).
+            // CTRL-ONLY: the spec parser rejects a bare
+            // "conndrop:p" the way the ctrl domain rejects
+            // trunc/corrupt. Spec arm: "ctrl-conndrop:p".
 };
 
 struct FaultDecision {

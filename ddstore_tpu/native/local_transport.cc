@@ -171,10 +171,12 @@ int LocalTransport::DrawCtrlFault(int target) {
   switch (d.kind) {
     case FaultKind::kReset:
     case FaultKind::kStall:
-      // No wire to reset here: both degrade to "this control op
-      // transiently failed" — the caller's bounded control retry
-      // absorbs it (stall fails WITHOUT sleeping, matching the local
-      // data-path convention: there is no client timeout to trip).
+    case FaultKind::kConnDrop:
+      // No wire to reset (or hard-close) here: all degrade to "this
+      // control op transiently failed" — the caller's bounded control
+      // retry absorbs it (stall fails WITHOUT sleeping, matching the
+      // local data-path convention: there is no client timeout to
+      // trip).
       return kErrTransport;
     case FaultKind::kDelay:
       FaultSleepMs(d.param_ms, nullptr);
@@ -274,6 +276,28 @@ int LocalTransport::SnapshotControl(int target, int64_t snap_id,
     return group_->AliveOrPending(target) ? kErrTransport : kErrPeerLost;
   return pin ? peer->PinSnapshot(snap_id, tenant)
              : peer->UnpinSnapshot(snap_id);
+}
+
+int LocalTransport::GatewayControl(int target, int verb,
+                                   const std::string& tenant,
+                                   int64_t arg, int64_t arg2,
+                                   int64_t* token_out) {
+  if (verb < 0 || verb > 2) return kErrInvalidArg;
+  for (int att = 0;; ++att) {
+    if (DrawCtrlFault(target) == kOk) break;
+    if (att >= ctrl_retry_max_) return kErrTransport;
+  }
+  Store* peer = group_->member(target);
+  // Same death classification as SnapshotControl: a reaped member is
+  // kErrPeerLost, a not-yet-registered one a transient failure.
+  if (!peer)
+    return group_->AliveOrPending(target) ? kErrTransport : kErrPeerLost;
+  if (verb == 1) return peer->GatewayRenew(arg);
+  if (verb == 2) return peer->GatewayDetach(arg);
+  const int64_t token = peer->GatewayAttach(tenant, arg != 0, arg2);
+  if (token < 0) return static_cast<int>(token);
+  if (token_out) *token_out = token;
+  return kOk;
 }
 
 int64_t LocalTransport::ReadMetrics(int target, void* out, int64_t cap) {

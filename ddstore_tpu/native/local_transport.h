@@ -113,6 +113,9 @@ class LocalTransport : public Transport {
   // owner-side half (control plane, no DATA-plane fault-injector
   // draw; the separate ctrl arm injects here and is absorbed by the
   // bounded control-retry loop, like the TCP side).
+  int GatewayControl(int target, int verb, const std::string& tenant,
+                     int64_t arg, int64_t arg2,
+                     int64_t* token_out) override;
   int SnapshotControl(int target, int64_t snap_id, bool pin,
                       const std::string& tenant) override;
   // ddmetrics histogram pull: direct serialization out of the peer

@@ -44,6 +44,19 @@ long AsyncThreadsFromEnv() {
   const unsigned hw = std::thread::hardware_concurrency();
   return hw >= 8 ? 4 : (hw >= 4 ? 2 : 1);
 }
+
+// In-flight accounting around one admitted read op (Drain waits on
+// it; OpEnd wakes deferred waiters). Null gateway = gateway off =
+// zero-cost scope.
+struct GwOpScope {
+  gw::Gateway* g;
+  explicit GwOpScope(gw::Gateway* gg) : g(gg) {
+    if (g) g->OpBegin();
+  }
+  ~GwOpScope() {
+    if (g) g->OpEnd();
+  }
+};
 }  // namespace
 
 const char* ErrorString(int code) {
@@ -65,6 +78,9 @@ const char* ErrorString(int code) {
     case kErrCorrupt: return "data integrity failure (delivered bytes "
                              "disagree with the owner's published "
                              "checksums on every readable holder)";
+    case kErrAdmission: return "gateway admission refused (over-share "
+                               "tenant deferred past its window or rank "
+                               "draining; back off and retry)";
     default: return "unknown error";
   }
 }
@@ -246,6 +262,30 @@ Store::Store(std::unique_ptr<Transport> transport)
   }
   if (const char* env = std::getenv("DDSTORE_TENANT_SLOS"))
     SetTenantSlos(env);
+  // Serving gateway (gateway.h). Default OFF: the whole feature costs
+  // one relaxed load per read op and starts no thread. The reaper also
+  // arms when only DDSTORE_SNAP_PIN_TTL_MS is set — stranded-pin
+  // reclaim is a standalone fix that works with the gateway off.
+  {
+    auto env_long = [](const char* name, long dflt) {
+      const char* env = std::getenv(name);
+      if (!env || !*env) return dflt;
+      char* end = nullptr;
+      const long v = std::strtol(env, &end, 10);
+      return end != env ? v : dflt;
+    };
+    const int gw_on = env_long("DDSTORE_GATEWAY", 0) > 0 ? 1 : 0;
+    const long pin_ttl = env_long("DDSTORE_SNAP_PIN_TTL_MS", 0);
+    if (gw_on || pin_ttl > 0)
+      ConfigureGateway(gw_on, env_long("DDSTORE_GW_LEASE_MS", 5000),
+                       env_long("DDSTORE_GW_DEFER_MS", 100),
+                       static_cast<int>(env_long("DDSTORE_GW_QUEUE", 64)),
+                       static_cast<int>(
+                           env_long("DDSTORE_GW_ADMIT_MARGIN", 80)),
+                       static_cast<int>(
+                           env_long("DDSTORE_GW_LANE_SHARE", 0)),
+                       pin_ttl);
+  }
   health_.Init(rank(), world());
   if (scrub_ms > 0) ConfigureScrub(scrub_ms);
   if (world() > 1) {
@@ -267,7 +307,10 @@ Store::Store(std::unique_ptr<Transport> transport)
 Store::~Store() {
   // The scrubber reads shards and the control plane; the ping thread
   // dials through the transport: both must stop before any teardown
-  // the transport participates in.
+  // the transport participates in. The gateway reaper releases leases
+  // through the same control plane, so it stops first; gw_stop_ also
+  // aborts any admission defer-wait still parked in a reader thread.
+  StopGwReaper();
   StopScrub();
   health_.Stop();
   // In-flight async reads hold the shared lock and use the transport;
@@ -465,6 +508,12 @@ int Store::Update(const std::string& name, const void* buf, int64_t nrows,
 int Store::Get(const std::string& name, void* dst, int64_t start,
                int64_t count, const std::string& as_tenant) {
   if (!dst || start < 0 || count <= 0) return kErrInvalidArg;
+  // Gateway admission gate: one relaxed load when off.
+  if (gateway_.enabled()) {
+    const int arc = GatewayAdmit(name, as_tenant);
+    if (arc != kOk) return arc;
+  }
+  GwOpScope gw_scope(gateway_.enabled() ? &gateway_ : nullptr);
   VarInfo v;
   if (!GetVarInfo(name, &v)) return kErrNotFound;
   if (start + count > v.total_rows()) return kErrOutOfRange;
@@ -558,6 +607,14 @@ struct Run {
 
 int Store::GetBatch(const std::string& name, void* dst, const int64_t* starts,
                     int64_t n, const std::string& as_tenant) {
+  // Gateway admission gate: PUBLIC entry only — internal cache fills
+  // (GetBatchImpl with use_cache=false) are never gated, they run on
+  // behalf of already-admitted work. One relaxed load when off.
+  if (gateway_.enabled()) {
+    const int arc = GatewayAdmit(name, as_tenant);
+    if (arc != kOk) return arc;
+  }
+  GwOpScope gw_scope(gateway_.enabled() ? &gateway_ : nullptr);
   return GetBatchImpl(name, dst, starts, n, as_tenant,
                       /*use_cache=*/true);
 }
@@ -2039,6 +2096,7 @@ int Store::PinSnapshot(int64_t snap_id, const std::string& tenant) {
   std::unique_lock<std::shared_mutex> lock(mu_);
   SnapPin sp;
   sp.tenant = tenant;
+  sp.created_ns = metrics::OpTimer::NowNs();
   for (const auto& kv : vars_) {
     if (kv.first.empty() || kv.first[0] == '\x01' ||
         kv.first[0] == '\x03')
@@ -2138,7 +2196,7 @@ void Store::SnapshotCounters(int64_t out[4]) const {
   out[0] = static_cast<int64_t>(snap_pins_.size());
   out[1] = kept_versions_;
   out[2] = kept_bytes_;
-  out[3] = 0;
+  out[3] = snap_reclaimed_.load(std::memory_order_relaxed);
 }
 
 int Store::ReadViaReplica(const std::string& name, int owner,
@@ -2518,6 +2576,14 @@ int Store::ReadRuns(const std::string& name, char* dst,
                     const std::vector<int64_t>& dst_off,
                     const std::vector<int64_t>& nbytes,
                     const std::string& as_tenant) {
+  // Gateway admission gate: one relaxed load when off. Runs on pool
+  // threads (async bodies) too — a deferred async read parks here for
+  // at most defer_ms before surfacing kErrAdmission to the waiter.
+  if (gateway_.enabled()) {
+    const int arc = GatewayAdmit(name, as_tenant);
+    if (arc != kOk) return arc;
+  }
+  GwOpScope gw_scope(gateway_.enabled() ? &gateway_ : nullptr);
   VarInfo v;
   if (!GetVarInfo(name, &v)) return kErrNotFound;
   const int64_t nruns = static_cast<int64_t>(targets.size());
@@ -3113,6 +3179,305 @@ void Store::SloStats(int64_t out[8]) const {
   out[2] = slo_breaches_;
   out[3] = slo_window_ms_;
   out[4] = slo_last_breach_tenant_;
+}
+
+// -- serving gateway ---------------------------------------------------------
+
+int Store::ConfigureGateway(int enabled, long lease_ms, long defer_ms,
+                            int queue_cap, int admit_margin_pct,
+                            int lane_share, long pin_ttl_ms) {
+  gw::Config c = gateway_.config();
+  if (enabled >= 0) c.enabled = enabled ? 1 : 0;
+  if (lease_ms >= 0) c.lease_ms = lease_ms > 0 ? lease_ms : 5000;
+  if (defer_ms >= 0) c.defer_ms = defer_ms > 0 ? defer_ms : 100;
+  if (queue_cap >= 0) c.queue_cap = queue_cap > 0 ? queue_cap : 64;
+  if (admit_margin_pct >= 0)
+    c.admit_margin_pct = admit_margin_pct > 0 ? admit_margin_pct : 1;
+  if (lane_share >= 0) c.lane_share = lane_share;
+  gateway_.Configure(c);
+  gw_admit_margin_pct_.store(c.admit_margin_pct,
+                             std::memory_order_relaxed);
+  gw_lane_share_.store(c.lane_share, std::memory_order_relaxed);
+  if (pin_ttl_ms >= 0)
+    snap_pin_ttl_ms_.store(pin_ttl_ms, std::memory_order_relaxed);
+  // Reaper cadence: the lease-renewal heartbeat cadence (~lease/3,
+  // HealthMonitor-style) when the gateway is on; half the pin TTL
+  // when only stranded-pin reclaim is armed; stopped when neither.
+  long reap_ms = 0;
+  const long ttl = snap_pin_ttl_ms_.load(std::memory_order_relaxed);
+  if (c.enabled)
+    reap_ms = c.lease_ms / 3 > 0 ? c.lease_ms / 3 : 1;
+  else if (ttl > 0)
+    reap_ms = ttl / 2 > 0 ? ttl / 2 : 1;
+  ConfigureGwReaper(reap_ms);
+  return kOk;
+}
+
+int64_t Store::GatewayAttach(const std::string& tenant,
+                             int with_snapshot, int64_t quota_bytes) {
+  if (!gateway_.enabled()) return kErrInvalidArg;
+  if (gateway_.draining()) return kErrAdmission;
+  // Reserve BEFORE minting the lease so an over-quota attach fails
+  // atomically (nothing to reap).
+  bool charged = false;
+  if (quota_bytes > 0 &&
+      !TenantReserveBytes(tenant, quota_bytes, &charged))
+    return kErrQuota;
+  int64_t snap_id = 0;
+  if (with_snapshot) {
+    snap_id = SnapshotAcquire(tenant);
+    if (snap_id < 0) {
+      if (charged) TenantReleaseBytes(tenant, quota_bytes);
+      return snap_id;
+    }
+  }
+  bool first = false;
+  const int64_t token = gateway_.Attach(
+      rank(), tenant, snap_id, charged ? quota_bytes : 0,
+      metrics::OpTimer::NowNs(), &first);
+  if (token == 0) {  // drain raced in: roll back like a failed acquire
+    if (snap_id > 0) SnapshotRelease(snap_id);
+    if (charged) TenantReleaseBytes(tenant, quota_bytes);
+    return kErrAdmission;
+  }
+  // First live session of this tenant arms its lane-budget share:
+  // every ephemeral reader of the tenant now rides the same rotated
+  // lane slice instead of dialing private pools.
+  if (first) {
+    const int share = gw_lane_share_.load(std::memory_order_relaxed);
+    if (share > 0) transport_->SetTenantLaneBudget(tenant, share);
+  }
+  trace::Ev(trace::kGwSession, rank(), 0, token, snap_id);
+  return token;
+}
+
+int Store::GatewayRenew(int64_t token) {
+  if (!gateway_.enabled()) return kErrInvalidArg;
+  const int rc = gateway_.Renew(token, metrics::OpTimer::NowNs());
+  if (rc == kOk) trace::Ev(trace::kGwSession, rank(), 1, token, 0);
+  return rc;
+}
+
+int Store::GatewayDetach(int64_t token) {
+  if (!gateway_.enabled()) return kErrInvalidArg;
+  gw::SessionInfo s;
+  bool last = false;
+  const int rc = gateway_.Detach(token, &s, &last);
+  if (rc != kOk) return rc;
+  ReleaseGwSession(s, /*expired=*/false);
+  if (last && gw_lane_share_.load(std::memory_order_relaxed) > 0)
+    transport_->SetTenantLaneBudget(s.tenant, 0);
+  return kOk;
+}
+
+void Store::ReleaseGwSession(const gw::SessionInfo& s, bool expired) {
+  // The lease's whole footprint goes in one pass: snapshot pins (kept
+  // copies freed via the existing UnpinSnapshot path, peers
+  // best-effort), then the quota reservation. Deferred-queue slots
+  // die with the waiting call; lane shares are cleared by the caller
+  // on last-of-tenant.
+  if (s.snap_id > 0) SnapshotRelease(s.snap_id);
+  if (s.quota_bytes > 0) TenantReleaseBytes(s.tenant, s.quota_bytes);
+  trace::Ev(trace::kGwSession, rank(), expired ? 3 : 2, s.token,
+            s.snap_id);
+}
+
+int64_t Store::GatewayAttachTo(int target, const std::string& tenant,
+                               int with_snapshot, int64_t quota_bytes) {
+  if (target < 0 || target == rank())
+    return GatewayAttach(tenant, with_snapshot, quota_bytes);
+  if (target >= world()) return kErrInvalidArg;
+  int64_t token = 0;
+  const int rc = transport_->GatewayControl(
+      target, 0, tenant, with_snapshot ? 1 : 0, quota_bytes, &token);
+  return rc == kOk ? token : rc;
+}
+
+int Store::GatewayRenewTo(int target, int64_t token) {
+  if (target < 0 || target == rank()) return GatewayRenew(token);
+  if (target >= world()) return kErrInvalidArg;
+  return transport_->GatewayControl(target, 1, "", token, 0, nullptr);
+}
+
+int Store::GatewayDetachTo(int target, int64_t token) {
+  if (target < 0 || target == rank()) return GatewayDetach(token);
+  if (target >= world()) return kErrInvalidArg;
+  return transport_->GatewayControl(target, 2, "", token, 0, nullptr);
+}
+
+int Store::GatewayDrain(long deadline_ms) {
+  if (!gateway_.enabled()) return kOk;
+  return gateway_.Drain(deadline_ms, &gw_stop_);
+}
+
+int Store::GatewayReap() {
+  const uint64_t now = metrics::OpTimer::NowNs();
+  if (gateway_.enabled()) {
+    std::vector<gw::SessionInfo> dead;
+    std::vector<std::string> cleared;
+    gateway_.ExpireLeases(now, &dead, &cleared);
+    for (const gw::SessionInfo& s : dead)
+      ReleaseGwSession(s, /*expired=*/true);
+    if (gw_lane_share_.load(std::memory_order_relaxed) > 0)
+      for (const std::string& t : cleared)
+        transport_->SetTenantLaneBudget(t, 0);
+  }
+  // Stale-pin reclaim (works gateway-off): TTL-expired pins and pins
+  // minted by a suspected-dead owner rank (snap ids carry their
+  // minting rank in the top 32 bits). Pins held by a LIVE gateway
+  // lease are exempt — the lease is their liveness.
+  const long ttl_ms = snap_pin_ttl_ms_.load(std::memory_order_relaxed);
+  std::vector<int64_t> stale;
+  {
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    for (const auto& kv : snap_pins_) {
+      if (gateway_.HoldsSnapshot(kv.first)) continue;
+      const int owner = static_cast<int>(kv.first >> 32);
+      const bool dead_owner = owner != rank() && owner >= 0 &&
+                              owner < world() && PeerSuspected(owner);
+      const bool ttl_hit =
+          ttl_ms > 0 && kv.second.created_ns != 0 &&
+          now > kv.second.created_ns &&
+          now - kv.second.created_ns >
+              static_cast<uint64_t>(ttl_ms) * 1000000ull;
+      if (dead_owner || ttl_hit) stale.push_back(kv.first);
+    }
+  }
+  int reclaimed = 0;
+  for (int64_t id : stale)
+    if (UnpinSnapshot(id) == kOk) ++reclaimed;
+  if (reclaimed > 0) {
+    snap_reclaimed_.fetch_add(reclaimed, std::memory_order_relaxed);
+    trace::Ev(trace::kGwSession, rank(), 4, reclaimed, 0);
+  }
+  return reclaimed;
+}
+
+void Store::GatewayStats(int64_t out[gw::kGwStatSlots]) const {
+  gateway_.Stats(out);
+}
+
+int Store::GatewayAdmit(const std::string& name,
+                        const std::string& as_tenant) {
+  const std::string tenant =
+      as_tenant.empty() ? TenantOfVarName(name) : as_tenant;
+  // Protected = the tenant has an SLO rule: admission exists to keep
+  // THESE tenants inside their objectives, so they always flow.
+  bool is_protected = false;
+  {
+    std::lock_guard<std::mutex> lock(slo_mu_);
+    for (const SloRule& r : slo_rules_)
+      if (r.tenant == tenant) {
+        is_protected = true;
+        break;
+      }
+  }
+  long retry_after = 0;
+  const int rc = gateway_.Admit(
+      is_protected, [this] { return GatewayPressure(); }, &gw_stop_,
+      &retry_after);
+  if (rc != kOk) {
+    trace::Ev(trace::kGwShed, rank(), 1, retry_after,
+              gateway_.draining() ? 1 : 0);
+    // Shed storm: one flight dump per 64 rejects (the first included)
+    // — the "who was shed and why" postmortem without flooding the
+    // flight buffer during a sustained storm.
+    if (gw_sheds_since_flight_.fetch_add(1, std::memory_order_relaxed) %
+            64 ==
+        0)
+      trace::Flight(trace::kReasonShedStorm, rank());
+  }
+  return rc;
+}
+
+bool Store::GatewayPressure() {
+  // Queue-depth model input: the async admission gate's deferred
+  // backlog. Read BEFORE slo_mu_ — both stay leaf mutexes.
+  uint64_t qdepth = 0;
+  {
+    std::lock_guard<std::mutex> lock(async_mu_);
+    qdepth = static_cast<uint64_t>(async_deferred_.size());
+  }
+  const int margin =
+      gw_admit_margin_pct_.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lock(slo_mu_);
+  for (const SloRule& r : slo_rules_) {
+    uint64_t cur[metrics::kBuckets];
+    uint64_t cnt = 0;
+    metrics_.TenantLatHist(r.tenant_id, cur, &cnt);
+    uint64_t n = 0;
+    uint64_t delta[metrics::kBuckets];
+    for (int b = 0; b < metrics::kBuckets; ++b) {
+      delta[b] = cur[b] >= r.base_hist[b] ? cur[b] - r.base_hist[b]
+                                          : cur[b];
+      n += delta[b];
+    }
+    if (n == 0) continue;  // idle protected tenant: no pressure signal
+    const uint64_t want =
+        (n * static_cast<uint64_t>(r.pct) + 99) / 100;
+    uint64_t cum = 0;
+    int qb = metrics::kBuckets - 1;
+    for (int b = 0; b < metrics::kBuckets; ++b) {
+      cum += delta[b];
+      if (cum >= want) {
+        qb = b;
+        break;
+      }
+    }
+    // Predicted p99: the live window quantile's CONSERVATIVE upper
+    // bucket edge (EvaluateSlos uses the lower edge — it must prove a
+    // breach; this gate must prevent one), scaled by the queued
+    // backlog (each deferred read adds roughly one service time to
+    // whatever lands behind it). Baselines are NOT advanced:
+    // EvaluateSlos owns the window; this is a read-only view of the
+    // same delta. Float math — thresholds are user input and an
+    // integer product can overflow.
+    const long double predicted =
+        static_cast<long double>(metrics::BucketHigh(qb)) *
+        (1.0L + static_cast<long double>(qdepth));
+    const long double limit =
+        static_cast<long double>(r.threshold_ns) * margin / 100.0L;
+    if (predicted >= limit) return true;
+  }
+  return false;
+}
+
+void Store::ConfigureGwReaper(long interval_ms) {
+  // Whole stop+start transition is one critical section (the scrub
+  // discipline: two racing configures must never assign over a
+  // joinable std::thread).
+  std::lock_guard<std::mutex> cfg(gw_cfg_mu_);
+  StopGwReaperLocked();
+  if (interval_ms <= 0) return;
+  std::lock_guard<std::mutex> lock(gw_mu_);
+  gw_stop_.store(false, std::memory_order_relaxed);
+  gw_reap_ms_.store(interval_ms, std::memory_order_relaxed);
+  gw_thread_ = std::thread([this] { GwReaperLoop(); });
+}
+
+void Store::StopGwReaper() {
+  std::lock_guard<std::mutex> cfg(gw_cfg_mu_);
+  StopGwReaperLocked();
+}
+
+void Store::StopGwReaperLocked() {
+  gw_stop_.store(true, std::memory_order_relaxed);
+  // Join OUTSIDE gw_mu_ (gw_cfg_mu_ stays held — that is the point).
+  std::thread t;
+  {
+    std::lock_guard<std::mutex> lock(gw_mu_);
+    t = std::move(gw_thread_);
+  }
+  if (t.joinable()) t.join();
+}
+
+void Store::GwReaperLoop() {
+  while (!gw_stop_.load(std::memory_order_relaxed)) {
+    FaultSleepMs(gw_reap_ms_.load(std::memory_order_relaxed),
+                 &gw_stop_);
+    if (gw_stop_.load(std::memory_order_relaxed)) return;
+    GatewayReap();
+  }
 }
 
 }  // namespace dds

@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "fault.h"
+#include "gateway.h"
 #include "health.h"
 #include "integrity.h"
 #include "metrics_hist.h"
@@ -62,7 +63,7 @@ enum ErrorCode : int {
                          // DISTINCTLY from kErrPeerLost — nothing died,
                          // the tenant is over budget (free vars or raise
                          // the quota; retrying is pointless)
-  kErrCorrupt = -12      // data integrity failure (DDSTORE_VERIFY=1):
+  kErrCorrupt = -12,     // data integrity failure (DDSTORE_VERIFY=1):
                          // the delivered bytes disagree with the
                          // owner's published checksums at a STABLE
                          // content version, a primary re-read and every
@@ -70,6 +71,15 @@ enum ErrorCode : int {
                          // fatal like kErrQuota — nothing died; the
                          // Python layer names var + rows + peer and the
                          // ddtrace flight recorder dumps automatically
+  kErrAdmission = -13    // serving-gateway admission refusal: an
+                         // over-share tenant was deferred past its
+                         // window (or the rank is draining). Non-fatal
+                         // like kErrQuota — nothing died; the response
+                         // carries a retry-after hint and clients back
+                         // off with seeded jitter and try again.
+                         // (ISSUE 19 nominated -12, already taken by
+                         // kErrCorrupt since PR 11 — this is the next
+                         // free slot.)
 };
 
 const char* ErrorString(int code);
@@ -351,6 +361,36 @@ class Transport {
     (void)pin;
     (void)tenant;
     return kErrTransport;
+  }
+
+  // Serving-gateway session control op against `target`'s store.
+  // verb 0 = attach (`tenant` labels the session, `arg` != 0 pins a
+  // snapshot, `arg2` reserves quota bytes; the minted session token
+  // lands in *token_out), verb 1 = lease renew (`arg` = token),
+  // verb 2 = detach (`arg` = token). Control plane like
+  // Ping/ReadVarSeq — rides the dedicated control connection, never a
+  // data lane, never a DATA-plane fault-injector draw. Default:
+  // unsupported.
+  virtual int GatewayControl(int target, int verb,
+                             const std::string& tenant, int64_t arg,
+                             int64_t arg2, int64_t* token_out) {
+    (void)target;
+    (void)verb;
+    (void)tenant;
+    (void)arg;
+    (void)arg2;
+    (void)token_out;
+    return kErrTransport;
+  }
+
+  // Per-tenant QoS lane-budget knob (the gateway arms a share on a
+  // tenant's first live session and clears it on the last). Default:
+  // accepted no-op — transports without lane pools have nothing to
+  // budget.
+  virtual int SetTenantLaneBudget(const std::string& tenant, int lanes) {
+    (void)tenant;
+    (void)lanes;
+    return kOk;
   }
 
   // Install the store's suspect oracle: transports with an internal
@@ -793,11 +833,55 @@ class Store {
   // Owner-side halves (also the transport's control-op entry points).
   int PinSnapshot(int64_t snap_id, const std::string& tenant);
   int UnpinSnapshot(int64_t snap_id);
-  // [active_snapshots, kept_versions, kept_bytes, 0] on THIS rank.
+  // [active_snapshots, kept_versions, kept_bytes, reclaimed_pins] on
+  // THIS rank (reclaimed_pins counts pins released by the stale-pin
+  // reaper: TTL-expired or dead-owner, see GatewayReap).
   void SnapshotCounters(int64_t out[4]) const;
   // Snapshot-scoped registry name (exposed for the Python layer/tests).
   static std::string SnapVarName(int64_t snap_id, const std::string& name);
   static std::string KeepVarName(int64_t seq, const std::string& name);
+
+  // -- serving gateway (gateway.h) -------------------------------------------
+  //
+  // Ephemeral-reader session multiplexing + histogram-driven admission
+  // control. Default OFF (DDSTORE_GATEWAY=0): no thread, no lock, one
+  // relaxed load per read op — byte-identical to the pre-gateway tree.
+
+  // Runtime (re)configure; -1 keeps each numeric field. enabled >= 1
+  // clears a previous drain; pin_ttl_ms / enabled also (re)arm the
+  // background lease/pin reaper (scrub-pattern lifecycle).
+  int ConfigureGateway(int enabled, long lease_ms, long defer_ms,
+                       int queue_cap, int admit_margin_pct,
+                       int lane_share, long pin_ttl_ms);
+  // Local session lifecycle (also the transport's kOpAttach/kOpDetach/
+  // kOpLease serve entry points). Attach reserves `quota_bytes`
+  // against the tenant budget, optionally pins a snapshot, and arms
+  // the tenant's lane-budget share on its FIRST live session; returns
+  // a positive token or a negative ErrorCode.
+  int64_t GatewayAttach(const std::string& tenant, int with_snapshot,
+                        int64_t quota_bytes);
+  int GatewayRenew(int64_t token);
+  // Detach releases everything the lease held (snapshot pins via the
+  // UnpinSnapshot path, quota reservation, lane share when last-of-
+  // tenant). Lease expiry runs the exact same release.
+  int GatewayDetach(int64_t token);
+  // Remote flavors (target == rank() or target < 0 degrade to local).
+  int64_t GatewayAttachTo(int target, const std::string& tenant,
+                          int with_snapshot, int64_t quota_bytes);
+  int GatewayRenewTo(int target, int64_t token);
+  int GatewayDetachTo(int target, int64_t token);
+  // Graceful drain: stop admitting, wait up to deadline_ms for
+  // in-flight reads, shed the rest with kErrAdmission. Wired into
+  // elastic recovery so a leaving rank drains instead of RSTing.
+  int GatewayDrain(long deadline_ms);
+  // One synchronous reap pass (the background reaper runs this same
+  // body): expire leases + release what they held, then reclaim stale
+  // snapshot pins — TTL-expired (DDSTORE_SNAP_PIN_TTL_MS) or pinned
+  // by a suspected-dead owner rank — via UnpinSnapshot. Pins held by
+  // a LIVE gateway lease are exempt (the lease is their liveness).
+  // Returns the number of pins reclaimed.
+  int GatewayReap();
+  void GatewayStats(int64_t out[gw::kGwStatSlots]) const;
 
   // Metadata query: total rows across all ranks (reference `query`,
   // src/ddstore.cxx:46-49) plus shape info.
@@ -1008,6 +1092,19 @@ class Store {
   void StopScrubLocked() DDS_REQUIRES(scrub_cfg_mu_);
   void ScrubLoop();
 
+  // Serving-gateway plumbing. GatewayAdmit is the per-read gate
+  // (kOk / kErrAdmission); GatewayPressure is the histogram + queue-
+  // depth predicate passed into gw::Gateway::Admit (re-evaluated on
+  // completion wakeups); ReleaseGwSession releases what an expired or
+  // detached lease held. The reaper reuses the scrub lifecycle.
+  int GatewayAdmit(const std::string& name, const std::string& as_tenant);
+  bool GatewayPressure();
+  void ReleaseGwSession(const gw::SessionInfo& s, bool expired);
+  void ConfigureGwReaper(long interval_ms);
+  void StopGwReaper();
+  void StopGwReaperLocked() DDS_REQUIRES(gw_cfg_mu_);
+  void GwReaperLoop();
+
   // Pin-aware registry resolution, the single point every read-serving
   // leg (ReadLocal/ReadLocalV/WithShard — local memcpy, CMA fallback,
   // TCP streaming alike) goes through: a snapshot-scoped name resolves
@@ -1073,11 +1170,14 @@ class Store {
   struct SnapPin {
     std::string tenant;                   // acquiring handle's label
     std::map<std::string, int64_t> pins;  // var -> pinned update_seq
+    uint64_t created_ns = 0;              // stale-pin TTL reap basis
   };
   std::map<int64_t, SnapPin> snap_pins_ DDS_GUARDED_BY(mu_);
   int64_t snap_counter_ DDS_GUARDED_BY(mu_) = 0;
   int64_t kept_versions_ DDS_GUARDED_BY(mu_) = 0;
   int64_t kept_bytes_ DDS_GUARDED_BY(mu_) = 0;
+  // Pins released by the stale-pin reaper (SnapshotCounters[3]).
+  std::atomic<int64_t> snap_reclaimed_{0};
 
   // Readers (gets, serving threads) take shared; add/init/update/free take
   // exclusive, so shard memory can't be freed or overwritten mid-read.
@@ -1101,6 +1201,16 @@ class Store {
   // must be destroyed AFTER ~Transport joins them (reverse member
   // order) — an ASan-caught teardown race otherwise.
   metrics::Registry metrics_;
+  // Serving gateway (sessions + admission). Declared BEFORE transport_
+  // like metrics_: the TCP transport's serving threads call
+  // GatewayAttach/Renew/Detach (the kOpAttach/kOpDetach/kOpLease
+  // serves), so it must outlive ~Transport's thread join.
+  gw::Gateway gateway_;
+  std::atomic<int> gw_admit_margin_pct_{80};
+  std::atomic<int> gw_lane_share_{0};
+  std::atomic<long> snap_pin_ttl_ms_{0};
+  // Shed-storm flight trigger: rejects since the last flight dump.
+  std::atomic<int64_t> gw_sheds_since_flight_{0};
   std::unique_ptr<Transport> transport_;
   bool fence_active_ DDS_GUARDED_BY(mu_) = false;
   bool epoch_collective_ = true;
@@ -1263,12 +1373,24 @@ class Store {
   std::atomic<long> scrub_interval_ms_{0};
   std::string scrub_cursor_ DDS_GUARDED_BY(scrub_mu_);
 
+  // Gateway lease/pin reaper: scrub-pattern lifecycle (gw_cfg_mu_
+  // serializes whole stop/start transitions and is held across the
+  // join; gw_mu_ guards only the thread handle and is never held
+  // while blocking). Runs when the gateway is enabled OR a pin TTL is
+  // configured (satellite: stranded-pin reclaim works gateway-off).
+  std::mutex gw_cfg_mu_ DDS_ACQUIRED_BEFORE(gw_mu_);
+  std::mutex gw_mu_;
+  std::atomic<bool> gw_stop_{false};
+  std::atomic<long> gw_reap_ms_{0};
+
   // Heartbeat failure detector + suspect registry. Declared LAST (with
   // the scrub thread) so it is destroyed FIRST (reverse member order):
   // the ping thread must be joined before the transport it pings goes
   // away.
   HealthMonitor health_ DDS_DESTROYED_BEFORE(transport_);
   std::thread scrub_thread_ DDS_GUARDED_BY(scrub_mu_)
+      DDS_DESTROYED_BEFORE(transport_);
+  std::thread gw_thread_ DDS_GUARDED_BY(gw_mu_)
       DDS_DESTROYED_BEFORE(transport_);
 };
 

@@ -47,7 +47,13 @@ enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
                      // ddmetrics histogram pull (control plane):
                      // response payload = the serving store's packed
                      // metrics::CellRecord snapshot.
-                     kOpMetrics = 10 };
+                     kOpMetrics = 10,
+                     // Serving-gateway session control (control
+                     // plane): attach (name = tenant label, tag != 0
+                     // pins a snapshot, offset = quota bytes; minted
+                     // session token returned in resp.nbytes), detach
+                     // and lease renew (tag = session token).
+                     kOpAttach = 11, kOpDetach = 12, kOpLease = 13 };
 
 #pragma pack(push, 1)
 struct WireReq {
@@ -761,14 +767,18 @@ void TcpTransport::HandleConnection(int fd) {
     // this arm present or absent.
     if (req.op == kOpVarSeq || req.op == kOpRowSums ||
         req.op == kOpSnapPin || req.op == kOpSnapUnpin ||
-        req.op == kOpMetrics) {
+        req.op == kOpMetrics || req.op == kOpAttach ||
+        req.op == kOpDetach || req.op == kOpLease) {
       FaultInjector& fi = FaultInjector::Get();
       if (fi.enabled()) {
         const FaultDecision fdec = fi.DrawCtrl(rank_);
-        if (fdec.kind == FaultKind::kReset) {
+        if (fdec.kind == FaultKind::kReset ||
+            fdec.kind == FaultKind::kConnDrop) {
           // Drop the control connection pre-response: the client's
           // ControlRoundTrip fails its recv, closes, and its bounded
-          // control-retry loop redials.
+          // control-retry loop redials. ctrl-conndrop shares the
+          // mechanics but is a separately armable arm targeting
+          // gateway/control sessions mid-flight.
           ::shutdown(fd, SHUT_RDWR);
           return;
         }
@@ -886,6 +896,37 @@ void TcpTransport::HandleConnection(int fd) {
         rc = req.op == kOpSnapPin ? store_->PinSnapshot(req.tag, name)
                                   : store_->UnpinSnapshot(req.tag);
       WireResp resp{rc, 0, 0};
+      if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
+      continue;
+    }
+    if (req.op == kOpAttach || req.op == kOpDetach ||
+        req.op == kOpLease) {
+      // Serving-gateway session control. Attach mints the session on
+      // THIS rank's store (name = tenant, tag != 0 pins a snapshot,
+      // offset = quota bytes) and returns the token in resp.nbytes;
+      // renew/detach address an existing lease by token (tag). These
+      // handlers only touch the gateway lease table and the registry
+      // — nothing slow runs while the remote reader waits.
+      int rc = kErrNotFound;
+      int64_t token = 0;
+      if (store_) {
+        if (req.op == kOpAttach) {
+          const int64_t t =
+              store_->GatewayAttach(name, req.tag != 0 ? 1 : 0,
+                                    req.offset);
+          if (t < 0) {
+            rc = static_cast<int>(t);
+          } else {
+            rc = kOk;
+            token = t;
+          }
+        } else if (req.op == kOpLease) {
+          rc = store_->GatewayRenew(req.tag);
+        } else {
+          rc = store_->GatewayDetach(req.tag);
+        }
+      }
+      WireResp resp{rc, 0, token};
       if (FullSend(fd, &resp, sizeof(resp)) != 0) return;
       continue;
     }
@@ -1495,6 +1536,40 @@ int TcpTransport::SnapshotControl(int target, int64_t snap_id, bool pin,
     if (att >= control_retry_max_) return kErrTransport;
     FaultSleepMs(ControlBackoffMs(att), &stopping_);
   }
+  return resp.status;
+}
+
+int TcpTransport::GatewayControl(int target, int verb,
+                                 const std::string& tenant, int64_t arg,
+                                 int64_t arg2, int64_t* token_out) {
+  if (target < 0 || target >= world_ || target == rank_ || verb < 0 ||
+      verb > 2)
+    return kErrInvalidArg;
+  // Same ladder as SnapshotControl: suspected peers short-circuit,
+  // transport failures (including a ctrl-conndrop hard-close) redial
+  // within the bounded control-retry budget.
+  const std::function<bool(int)> suspect = SuspectSnapshot();
+  PingConn& pc = *ping_conns_[target];
+  WireResp resp;
+  const uint32_t op =
+      verb == 0 ? kOpAttach : (verb == 1 ? kOpLease : kOpDetach);
+  for (int att = 0;; ++att) {
+    if (suspect && suspect(target)) return kErrPeerLost;
+    if (stopping_.load(std::memory_order_relaxed)) return kErrTransport;
+    bool ok;
+    {
+      std::lock_guard<std::mutex> lock(pc.mu);
+      if (pc.port < 0 || pc.hosts.empty()) return kErrTransport;
+      // Attach: tag = with-snapshot flag, offset = quota bytes.
+      // Renew/detach: tag = session token.
+      ok = ControlRoundTrip(pc, op, tenant, control_timeout_ms_, &resp,
+                            arg, verb == 0 ? arg2 : 0);
+    }
+    if (ok) break;
+    if (att >= control_retry_max_) return kErrTransport;
+    FaultSleepMs(ControlBackoffMs(att), &stopping_);
+  }
+  if (resp.status == kOk && token_out) *token_out = resp.nbytes;
   return resp.status;
 }
 

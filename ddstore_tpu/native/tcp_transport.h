@@ -213,6 +213,14 @@ class TcpTransport : public Transport {
   int SnapshotControl(int target, int64_t snap_id, bool pin,
                       const std::string& tenant) override
       DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
+  // Serving-gateway session control (kOpAttach/kOpDetach/kOpLease),
+  // same dedicated control connection and bounded-retry ladder as
+  // SnapshotControl. Never a data lane, never a DATA-plane injector
+  // draw (the ctrl arm — including ctrl-conndrop — injects
+  // server-side).
+  int GatewayControl(int target, int verb, const std::string& tenant,
+                     int64_t arg, int64_t arg2, int64_t* token_out)
+      override DDS_EXCLUDES(Conn::mu, route_mu_, lane_mu_);
   // ddmetrics histogram pull (kOpMetrics), over the same dedicated
   // control connection: the peer's packed CellRecord snapshot lands in
   // `out`. Never a data lane, never a DATA-plane injector draw (the
@@ -224,7 +232,7 @@ class TcpTransport : public Transport {
   // engage at most `lanes` lanes (the cost-model scheduler plans these
   // as share-weighted splits of the tuned width; <= 0 clears). No
   // budgets configured = zero cost on the read path.
-  int SetTenantLaneBudget(const std::string& tenant, int lanes);
+  int SetTenantLaneBudget(const std::string& tenant, int lanes) override;
   // The leaf retry layer's most recent failed target (failover names
   // the dead member of a multi-peer batch with this).
   int last_failed_peer() const override {

@@ -89,6 +89,12 @@ enum EventType : uint16_t {
   kSloBreach = 29,     // tenant latency SLO breached: a=interned tenant
                        // slot (ddmetrics), b=percentile (e.g. 99),
                        // c=measured quantile lower bound (ns)
+  kGwSession = 30,     // gateway lease lifecycle: a=verb (0 attach,
+                       // 1 renew, 2 detach, 3 lease expired, 4 stale-
+                       // pin reclaim pass), b=token (or reclaimed pin
+                       // count for verb 4), c=snap id
+  kGwShed = 31,        // admission refused: a=1, b=retry-after hint
+                       // (ms), c=1 when shed by a drain
 };
 
 // Op classes for kOpBegin/kOpEnd `a`. Keep in sync with binding.py
@@ -111,6 +117,7 @@ enum FlightReason : int {
   kReasonCorrupt = 6,
   kReasonBarrierAbort = 7,
   kReasonSloBreach = 8,
+  kReasonShedStorm = 9,
 };
 
 // The fixed-size dump record (48 bytes, packed, little-endian on every

@@ -97,6 +97,40 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_FAULT_RANKS", "config"),
     _k("DDSTORE_FAULT_SEED", "config"),
     _k("DDSTORE_FAULT_SPEC", "config"),
+    _k("DDSTORE_GATEWAY", "config",
+       desc="1 arms the serving gateway: kOpAttach/kOpLease sessions, "
+            "histogram-driven admission in front of Get/GetBatch/"
+            "ReadRuns (over-share tenants deferred then refused with "
+            "ERR_ADMISSION + retry-after), lease reaping, drain; "
+            "default 0, pinned byte-, error-code- and seeded-fault-"
+            "counter-identical to the ungated tree"),
+    _k("DDSTORE_GATEWAY_PHASE_TIMEOUT_S", "config",
+       desc="bench gateway-phase subprocess cap, default 300"),
+    _k("DDSTORE_GW_ADMIT_MARGIN", "config",
+       desc="admission margin in percent of each protected tenant's "
+            "SLO threshold (default 80): over-share reads defer once "
+            "predicted p99 = live-histogram p99 x (1 + async queue "
+            "depth) crosses threshold x margin/100"),
+    _k("DDSTORE_GW_DEFER_MS", "config",
+       desc="bounded deferral window before an over-share read is "
+            "refused with ERR_ADMISSION (default 100); the refusal's "
+            "retry-after hint scales with queue pressure"),
+    _k("DDSTORE_GW_LANE_SHARE", "config",
+       desc="QoS lane-budget share armed for a gateway tenant's first "
+            "session and cleared at its last detach (default 0 = "
+            "leave lane budgets to DDSTORE_TENANT_SHARES/scheduler)"),
+    _k("DDSTORE_GW_LEASE_MS", "config",
+       desc="gateway session lease (default 5000): client renews at "
+            "~lease/3; expiry atomically releases the session's "
+            "snapshot pins, quota reservation and lane share — the "
+            "SIGKILL-safety bound"),
+    _k("DDSTORE_GW_QUEUE", "config",
+       desc="bounded admission deferral queue per rank (default 64); "
+            "a full queue refuses immediately"),
+    _k("DDSTORE_GW_RETRY_MAX", "config",
+       desc="client-side ERR_ADMISSION retry budget per read in "
+            "GatewaySession (default 8), each retry sleeping the "
+            "server's retry-after hint with seeded jitter"),
     _k("DDSTORE_HEARTBEAT_MS", "config",
        desc="heartbeat ping interval (ms); unset = 250 when "
             "DDSTORE_REPLICATION > 1, else off; 0 disables"),
@@ -152,6 +186,11 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
             "evaluate_slos() call inside the window is a no-op that "
             "keeps the running delta window intact; default 0 = every "
             "call evaluates"),
+    _k("DDSTORE_SNAP_PIN_TTL_MS", "config",
+       desc="TTL for stranded snapshot pins (default 0 = off): the "
+            "reaper releases a pin whose owner is suspected dead or "
+            "whose age passed the TTL, counting snapshot_stats()"
+            "['reclaimed_pins'] — works with the gateway off"),
     _k("DDSTORE_SOAK_BUDGET_S", "config"),
     _k("DDSTORE_SOAK_PHASE_TIMEOUT_S", "config"),
     _k("DDSTORE_TENANTS_PHASE_TIMEOUT_S", "config",

@@ -510,6 +510,17 @@ class Scheduler:
         rebuild (mostly releasing knobs until fresh samples land)."""
         return self.replan("peer_change")
 
+    def on_admission_pressure(self, deferred: int, rejected: int) -> Plan:
+        """Serving-gateway defer pressure crossed an epoch boundary:
+        this job's reads were deferred (or shed outright) to protect a
+        tenant's SLO, so the measured throughput the current plan is
+        steering by includes queueing the plan did not choose. Replan —
+        typically narrowing async width / lane spread so the gateway
+        stops having to do the throttling for us."""
+        if rejected > 0:
+            return self.replan(f"admission:rejected={int(rejected)}")
+        return self.replan(f"admission:deferred={int(deferred)}")
+
     # -- consumption -------------------------------------------------------
 
     def planned_depth(self, requested: int) -> int:

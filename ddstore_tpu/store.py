@@ -19,8 +19,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .binding import (ERR_CORRUPT, ERR_PEER_LOST, DDStoreError,
-                      NativeStore)
+from .binding import (ERR_ADMISSION, ERR_CORRUPT, ERR_PEER_LOST,
+                      DDStoreError, NativeStore)
 from .rendezvous import (ProcessGroup, SingleGroup, ThreadGroup,
                          auto_group)
 
@@ -527,6 +527,24 @@ class DDStore:
                 f"(rows {preview}{more}) — the delivered batch was NOT "
                 f"silently used; inspect trace_flight_dump() and the "
                 f"named shard")
+        if e.code == ERR_ADMISSION:
+            # Defer-not-peer-lost: NOTHING died — the serving gateway
+            # refused admission to protect another tenant's SLO (or the
+            # rank is draining). Surface the retry-after hint so callers
+            # (GatewaySession, the loader's degraded ladder) back off
+            # with seeded jitter instead of escalating to elastic.recover.
+            try:
+                hint = int(self._native.gateway_stats()
+                           .get("last_retry_after_ms", 0))
+            except Exception:  # noqa: BLE001 — diagnostics must not mask e
+                hint = 0
+            err = DDStoreError(
+                e.code,
+                f"{name}: admission refused by the serving gateway "
+                f"(defer, not peer-lost — no rows were lost); retry "
+                f"after ~{hint} ms with jittered backoff")
+            err.retry_after_ms = hint
+            return err
         if e.code != ERR_PEER_LOST:
             return e
         peer = int(self._native.fault_stats().get("last_error_peer", -1))
@@ -1432,8 +1450,64 @@ class DDStore:
 
     def snapshot_stats(self) -> dict:
         """This rank's snapshot gauges: active pins, kept versions and
-        their RAM cost (the copy-on-publish ledger)."""
+        their RAM cost (the copy-on-publish ledger), plus
+        ``reclaimed_pins`` — the monotone count of stranded pins the
+        stale-pin reaper released (TTL-expired or dead-owner)."""
         return self._native.snapshot_stats()
+
+    # -- serving gateway ---------------------------------------------------
+
+    def gateway_configure(self, enabled: int = -1, lease_ms: int = -1,
+                          defer_ms: int = -1, queue_cap: int = -1,
+                          admit_margin_pct: int = -1,
+                          lane_share: int = -1,
+                          pin_ttl_ms: int = -1) -> None:
+        """Runtime serving-gateway (re)configuration; -1 keeps each
+        field. ``enabled=1`` clears a previous drain and (re)arms the
+        lease reaper; ``pin_ttl_ms`` arms stranded-snapshot-pin
+        reclaim even with the gateway off. Load-time knobs:
+        ``DDSTORE_GATEWAY`` / ``DDSTORE_GW_*`` /
+        ``DDSTORE_SNAP_PIN_TTL_MS``."""
+        self._native.gateway_configure(
+            enabled, lease_ms, defer_ms, queue_cap, admit_margin_pct,
+            lane_share, pin_ttl_ms)
+
+    def gateway_session(self, tenant: str = "", snapshot: bool = False,
+                        quota_bytes: int = 0, target: int = -1,
+                        max_retries: int = None, seed: int = None):
+        """Open an ephemeral reader session against ``target``'s
+        gateway (< 0 = this rank): a lease-renewed
+        :class:`~ddstore_tpu.gateway.GatewaySession` whose reads honor
+        admission control (``ERR_ADMISSION`` → seeded-jitter backoff
+        using the retry-after hint). Use as a context manager; a
+        reader SIGKILLed mid-session is reaped within O(lease) — its
+        pins, quota reservation and lane share released."""
+        from .gateway import GatewaySession
+
+        self._check_tenant_label(tenant)
+        return GatewaySession(self, tenant=tenant, snapshot=snapshot,
+                              quota_bytes=quota_bytes, target=target,
+                              max_retries=max_retries, seed=seed)
+
+    def gateway_drain(self, deadline_ms: int = 1000) -> bool:
+        """Graceful drain: stop admitting, let in-flight reads finish
+        under the deadline, shed the rest with ``ERR_ADMISSION``.
+        True when the gateway went quiet. ``elastic.recover`` drains a
+        leaving rank through this instead of RSTing its readers;
+        ``gateway_configure(enabled=1)`` re-opens."""
+        return self._native.gateway_drain(deadline_ms)
+
+    def gateway_reap(self) -> int:
+        """One synchronous lease/stale-pin reap pass (the
+        deterministic hook for what the background reaper does on its
+        cadence). Returns the number of stranded pins reclaimed."""
+        return self._native.gateway_reap()
+
+    def gateway_stats(self) -> dict:
+        """Gateway counters (``binding.GATEWAY_STAT_KEYS``): session
+        gauges, monotone attach/expiry and admission verdicts, and the
+        last retry-after hint."""
+        return self._native.gateway_stats()
 
     def _require(self, name: str) -> _VarMeta:
         if name not in self._meta:

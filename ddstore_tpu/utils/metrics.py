@@ -131,7 +131,8 @@ class PipelineMetrics:
     #:                            back to the host path on a transient
     #:                            staging failure
     FAULT_EVENT_KEYS = ("windows_retried", "window_batch_refetches",
-                        "readahead_degraded", "collective_batch_fallbacks")
+                        "readahead_degraded", "collective_batch_fallbacks",
+                        "admission_deferred_batches")
 
     def __init__(self, plan_source: Optional[Callable[[], Dict]] = None):
         self.wait = LatencyHistogram("device_wait")
@@ -231,6 +232,13 @@ class PipelineMetrics:
         self._slo_source: Optional[Callable[[], Dict]] = None
         self._slo_begin: Optional[Dict] = None
         self._slo_end: Optional[Dict] = None
+        # Serving-gateway source (DDStore.gateway_stats):
+        # summary()["gateway"] carries per-epoch admission/lease deltas
+        # (admitted/deferred/rejected, attach/expiry churn) with the
+        # session/drain gauges live.
+        self._gateway_source: Optional[Callable[[], Dict]] = None
+        self._gateway_begin: Optional[Dict] = None
+        self._gateway_end: Optional[Dict] = None
 
     def set_plan_source(self, source: Optional[Callable[[], Dict]]) -> None:
         """Attach a zero-arg callable returning cumulative planner
@@ -582,6 +590,46 @@ class PipelineMetrics:
                 out[k] = max(0, int(v) - int(self._slo_begin.get(k, 0)))
         return out
 
+    #: gauge keys of the gateway source (reported raw, never delta'd —
+    #: keep in sync with binding.GATEWAY_GAUGE_KEYS).
+    GATEWAY_GAUGES = ("enabled", "sessions", "draining", "inflight",
+                      "deferred_now", "last_retry_after_ms")
+
+    def set_gateway_source(self,
+                           source: Optional[Callable[[], Dict]]) -> None:
+        """Attach a zero-arg callable returning the serving gateway's
+        counters (``DDStore.gateway_stats``). Snapshotted at epoch
+        boundaries; ``summary()["gateway"]`` reports per-epoch
+        admission/lease deltas with the session and drain gauges
+        live."""
+        self._gateway_source = source
+
+    def _snap_gateway(self) -> Optional[Dict]:
+        if self._gateway_source is None:
+            return None
+        try:
+            return dict(self._gateway_source())
+        except Exception:
+            return None
+
+    def gateway_summary(self) -> Dict:
+        """Per-epoch gateway view: attach/detach/expiry churn and
+        admission verdict deltas (admitted/deferred/rejected/
+        drain_sheds), plus the live session/drain gauges."""
+        out: Dict = {}
+        if self._gateway_begin is None:
+            return out
+        end = self._gateway_end if self._gateway_end is not None \
+            else self._snap_gateway()
+        if end is None:
+            return out
+        for k, v in end.items():
+            if k in self.GATEWAY_GAUGES:
+                out[k] = v
+            else:
+                out[k] = max(0, int(v) - int(self._gateway_begin.get(k, 0)))
+        return out
+
     def set_sched_source(self, source: Optional[Callable[[], Dict]]) \
             -> None:
         """Attach a zero-arg callable returning the cost-model
@@ -731,6 +779,8 @@ class PipelineMetrics:
         self._latency_end = None
         self._slo_begin = self._snap_slo()
         self._slo_end = None
+        self._gateway_begin = self._snap_gateway()
+        self._gateway_end = None
         self._lane_begin = self._snap_lanes()
         self._lane_end = None
         with self._bytes_mu:
@@ -756,6 +806,7 @@ class PipelineMetrics:
         self._tiering_end = self._snap_tiering()
         self._latency_end = self._snap_latency()
         self._slo_end = self._snap_slo()
+        self._gateway_end = self._snap_gateway()
         self._lane_end = self._snap_lanes()
 
     @property
@@ -857,6 +908,14 @@ class PipelineMetrics:
                     or slo.get("evaluations", 0)
                     or slo.get("breaches", 0)):
             out["slo"] = slo
+        gw = self.gateway_summary()
+        # Included while the gateway is on (an all-zero verdict row is
+        # the "nothing was deferred" result the gateway bench reads) or
+        # any session/admission activity fired this epoch.
+        if gw and (gw.get("enabled", 0)
+                   or gw.get("attaches", 0) or gw.get("admitted", 0)
+                   or gw.get("deferred", 0) or gw.get("rejected", 0)):
+            out["gateway"] = gw
         if self._sched_source is not None:
             # Live (not epoch-frozen): the plan is a current-state view,
             # and a disabled scheduler's {"enabled": False} is itself
