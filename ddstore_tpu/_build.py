@@ -17,13 +17,14 @@ import threading
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
 _SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
-            "worker_pool.cc", "cma.cc", "fault.cc", "gateway.cc",
-            "health.cc", "integrity.cc", "metrics_hist.cc", "tier.cc",
-            "trace.cc", "capi.cc"]
+            "uring_transport.cc", "worker_pool.cc", "cma.cc", "fault.cc",
+            "gateway.cc", "health.cc", "integrity.cc", "metrics_hist.cc",
+            "tier.cc", "trace.cc", "capi.cc"]
 _HEADERS = ["store.h", "local_transport.h", "tcp_transport.h",
-            "worker_pool.h", "cma.h", "fault.h", "gateway.h",
-            "health.h", "integrity.h", "measure.h", "metrics_hist.h",
-            "tier.h", "trace.h", "thread_annotations.h"]
+            "uring_transport.h", "wire.h", "worker_pool.h", "cma.h",
+            "fault.h", "gateway.h", "health.h", "integrity.h",
+            "measure.h", "metrics_hist.h", "tier.h", "trace.h",
+            "thread_annotations.h"]
 _lock = threading.Lock()
 
 # Sanitizer builds (SURVEY §5: the reference has no TSan/ASan anywhere; the

@@ -53,6 +53,10 @@ BLOCKING_CALLS = {
     "recvfrom", "send", "sendmsg", "sendto", "readv", "writev",
     "read", "write", "getaddrinfo", "getenv", "usleep", "nanosleep",
     "sleep", "process_vm_readv", "posix_fallocate",
+    # io_uring: enter blocks when wait_nr > 0 (and may block on a full
+    # SQ even without); wait_cqe is liburing vocabulary — unused here
+    # (raw syscalls) but policed so a future wrapper can't slip in.
+    "io_uring_enter", "io_uring_wait_cqe",
     # std::this_thread
     "sleep_for", "sleep_until",
     # repo-known blocking wrappers
@@ -60,6 +64,8 @@ BLOCKING_CALLS = {
     "EnsureConnected", "DialWithTimeout", "ControlRoundTrip",
     "FaultSleepMs", "EnvLong", "EnvInt", "Wait", "join", "Barrier",
     "Ping", "ReadVOn", "ReadVOnRetry", "TryReadV",
+    # io_uring-era blocking wrappers (uring_transport.cc)
+    "SubmitAndWait", "UringReadVLocked", "ReadBatch", "EnvLongU",
 }
 
 #: condition_variable methods: the lock is (atomically) released while

@@ -209,6 +209,27 @@ def _load() -> ctypes.CDLL:
     lib.dds_cache_evict.argtypes = [ctypes.c_void_p, _i64]
     lib.dds_tiering_stats.restype = ctypes.c_int
     lib.dds_tiering_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_create_uring.restype = ctypes.c_void_p
+    lib.dds_create_uring.argtypes = [ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_int]
+    lib.dds_uring_probe.restype = ctypes.c_int
+    lib.dds_uring_probe.argtypes = [_i64p]
+    lib.dds_uring_probe_reason.restype = ctypes.c_int
+    lib.dds_uring_probe_reason.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dds_uring_state.restype = ctypes.c_int
+    lib.dds_uring_state.argtypes = [ctypes.c_void_p]
+    lib.dds_uring_reason.restype = ctypes.c_int
+    lib.dds_uring_reason.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+    lib.dds_uring_stats.restype = ctypes.c_int
+    lib.dds_uring_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_cold_direct_stats.restype = ctypes.c_int
+    lib.dds_cold_direct_stats.argtypes = [ctypes.c_void_p, _i64p]
+    lib.dds_set_var_file.restype = ctypes.c_int
+    lib.dds_set_var_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p]
+    lib.dds_req_send_stats.restype = ctypes.c_int
+    lib.dds_req_send_stats.argtypes = [ctypes.c_void_p, _i64p]
     lib.dds_metrics_configure.restype = ctypes.c_int
     lib.dds_metrics_configure.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.dds_metrics_enabled.restype = ctypes.c_int
@@ -295,6 +316,7 @@ def _load() -> ctypes.CDLL:
 
 # Error codes tested by the Python-side classification (mirrors
 # dds::ErrorCode; see native/store.h).
+ERR_INVALID_ARG = -1  # bad name / shape / range / tier
 ERR_NOT_FOUND = -2   # unknown variable / expired gateway lease token
 ERR_TRANSPORT = -6   # transient-class transport failure
 ERR_PEER_LOST = -10  # transient-retry budget exhausted: owner presumed
@@ -436,8 +458,8 @@ METRICS_CELL_DTYPE = np.dtype([
     ("bytes", "<u8", (METRICS_BUCKETS,))])
 
 #: route decode table (metrics_hist.h Route — ordered by the
-#: span_latency attribution precedence: cma > tcp > local).
-METRICS_ROUTES = {0: "local", 1: "tcp", 2: "cma"}
+#: span_latency attribution precedence: uring > cma > tcp > local).
+METRICS_ROUTES = {0: "local", 1: "tcp", 2: "cma", 3: "uring"}
 #: name -> code view (Python-side recorders / tests).
 METRICS_ROUTE_CODES = {v: k for k, v in METRICS_ROUTES.items()}
 
@@ -644,6 +666,53 @@ TIERING_GAUGE_KEYS = ("cache_max_bytes", "cache_bytes", "cache_entries",
                       "cold_vars", "cold_bytes")
 
 
+#: dict keys of :func:`uring_probe` in native layout order (keep in
+#: sync with capi dds_uring_probe). ``features`` is the raw
+#: IORING_FEAT_* bitmask from io_uring_setup; the op_* flags come from
+#: IORING_REGISTER_PROBE.
+URING_PROBE_KEYS = ("supported", "features", "op_send", "op_recv",
+                    "op_sendmsg", "op_recvmsg", "op_read",
+                    "op_read_fixed", "ext_arg", "reserved")
+
+#: dict keys of :meth:`NativeStore.uring_stats` in native layout order
+#: (keep in sync with capi dds_uring_stats /
+#: UringTransport::UringCounters). ``engaged`` is a gauge; the rest are
+#: monotone. A healthy engaged run shows ``enters`` far below
+#: ``frames`` — that ratio IS the syscall batching win.
+URING_STAT_KEYS = ("engaged", "bursts", "enters", "sqes", "frames",
+                   "fallbacks", "ring_errors")
+
+#: the gauge subset of :data:`URING_STAT_KEYS` (never delta'd).
+URING_GAUGE_KEYS = ("engaged",)
+
+#: dict keys of :meth:`NativeStore.cold_direct_stats` in native layout
+#: order (keep in sync with capi dds_cold_direct_stats /
+#: ColdDirectReader::Stats). ``files``/``regbuf``/``ring_ok`` are
+#: gauges; the rest monotone.
+COLD_DIRECT_STAT_KEYS = ("files", "reads", "bytes", "fallbacks",
+                         "regbuf", "ring_ok")
+
+#: the gauge subset of :data:`COLD_DIRECT_STAT_KEYS` (never delta'd).
+COLD_DIRECT_GAUGE_KEYS = ("files", "regbuf", "ring_ok")
+
+
+def uring_probe() -> dict:
+    """Process-wide io_uring capability verdict, independent of any
+    store (:data:`URING_PROBE_KEYS` plus a human ``reason`` string —
+    "ok", or why the kernel refused). Cached after the first call; the
+    diag module and the bench record it so a TCP-fallback run is
+    diagnosable from its artifacts alone."""
+    lib = _load()
+    arr = (ctypes.c_int64 * 10)()
+    _check(lib.dds_uring_probe(arr), "uring_probe")
+    out = dict(zip(URING_PROBE_KEYS, list(arr)))
+    del out["reserved"]
+    buf = ctypes.create_string_buffer(256)
+    lib.dds_uring_probe_reason(buf, 256)
+    out["reason"] = buf.value.decode(errors="replace")
+    return out
+
+
 def _as_i64p(arr: np.ndarray):
     return arr.ctypes.data_as(_i64p)
 
@@ -670,6 +739,20 @@ class NativeStore:
     def create_tcp(cls, rank: int, world: int, port: int = 0) -> "NativeStore":
         lib = _load()
         h = lib.dds_create_tcp(rank, world, port)
+        return cls(h)
+
+    @classmethod
+    def create_uring(cls, rank: int, world: int,
+                     port: int = 0) -> "NativeStore":
+        """io_uring wire backend (``DDSTORE_TRANSPORT=uring``). A
+        drop-in TcpTransport subclass: peers, lanes, faults, failover
+        and the gateway all behave identically; only the per-lane wire
+        loop batches a whole frame burst into one ``io_uring_enter``.
+        Construction NEVER fails on an io_uring-less kernel — the
+        handle serves through the inherited TCP path and
+        :meth:`uring_state`/:meth:`uring_reason` export the verdict."""
+        lib = _load()
+        h = lib.dds_create_uring(rank, world, port)
         return cls(h)
 
     # -- transport wiring --------------------------------------------------
@@ -1439,6 +1522,62 @@ class NativeStore:
                "tiering_stats")
         return dict(zip(TIERING_STAT_KEYS,
                         list(arr)[:len(TIERING_STAT_KEYS)]))
+
+    # -- io_uring data plane -----------------------------------------------
+
+    def uring_state(self) -> int:
+        """1 = uring handle with the ring engaged, 0 = uring handle
+        serving through the TCP fallback (kernel refused the probe),
+        -1 = not a uring handle."""
+        return int(self._lib.dds_uring_state(self._h))
+
+    def uring_reason(self) -> str:
+        """This handle's engagement/fallback reason ("ok" when
+        engaged; e.g. "io_uring_setup: Operation not permitted" under
+        a gVisor-class kernel). Empty string for non-uring handles."""
+        buf = ctypes.create_string_buffer(256)
+        rc = int(self._lib.dds_uring_reason(self._h, buf, 256))
+        if rc < 0:
+            return ""
+        return buf.value.decode(errors="replace")
+
+    def uring_stats(self) -> dict:
+        """Wire-loop counters (:data:`URING_STAT_KEYS`). Raises on
+        non-uring handles."""
+        arr = (ctypes.c_int64 * 7)()
+        _check(self._lib.dds_uring_stats(self._h, arr), "uring_stats")
+        return dict(zip(URING_STAT_KEYS, list(arr)))
+
+    def cold_direct_stats(self) -> dict:
+        """Cold-tier O_DIRECT reader counters
+        (:data:`COLD_DIRECT_STAT_KEYS`); zeros until a var registers
+        via :meth:`set_var_file`. Works on every handle kind."""
+        arr = (ctypes.c_int64 * 6)()
+        _check(self._lib.dds_cold_direct_stats(self._h, arr),
+               "cold_direct_stats")
+        return dict(zip(COLD_DIRECT_STAT_KEYS, list(arr)))
+
+    def set_var_file(self, name: str, path: str) -> bool:
+        """Register a READONLY cold (tier-1) var's backing file so its
+        local reads go O_DIRECT through the submission ring instead of
+        faulting the mmap. Returns False (never raises) when io_uring
+        or O_DIRECT is unavailable — the var stays on the mmap path,
+        which serves identical bytes."""
+        rc = int(self._lib.dds_set_var_file(self._h, name.encode(),
+                                            path.encode()))
+        if rc in (ERR_NOT_FOUND, ERR_INVALID_ARG):
+            raise DDStoreError(rc, f"set_var_file({name})")
+        return rc == 0
+
+    def req_send_stats(self) -> dict:
+        """Requester-side TCP pipeline send-gather counters:
+        ``req_frames`` / ``req_sends``. Their ratio is the writev
+        gather factor of the half-window refill (1.0 = the old
+        one-sendmsg-per-frame steady state)."""
+        arr = (ctypes.c_int64 * 2)()
+        _check(self._lib.dds_req_send_stats(self._h, arr),
+               "req_send_stats")
+        return {"req_frames": int(arr[0]), "req_sends": int(arr[1])}
 
     def fault_stats(self) -> dict:
         """Fault-injection + transient-retry counters: the process-global

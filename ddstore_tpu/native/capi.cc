@@ -16,6 +16,7 @@
 #include "store.h"
 #include "tcp_transport.h"
 #include "trace.h"
+#include "uring_transport.h"
 
 using dds::Store;
 
@@ -24,6 +25,7 @@ extern "C" {
 struct dds_handle {
   std::unique_ptr<Store> store;
   dds::TcpTransport* tcp = nullptr;      // borrowed, owned by store
+  dds::UringTransport* uring = nullptr;  // borrowed; also set as tcp (subclass)
   dds::LocalTransport* local = nullptr;  // borrowed, owned by store
   std::string local_gid;
 };
@@ -48,6 +50,25 @@ dds_handle* dds_create_tcp(int rank, int world, int port) {
   auto* h = new dds_handle();
   h->store = std::make_unique<Store>(std::move(transport));
   h->tcp = raw;
+  raw->Attach(h->store.get());
+  return h;
+}
+
+// DDSTORE_TRANSPORT=uring. A UringTransport IS a TcpTransport (the
+// wire loop is the only override), so every tcp entry point here —
+// dds_set_peers, dds_server_port, faults, failover, gateway — serves
+// uring handles through h->tcp unchanged. When the capability probe
+// refuses (gVisor-class kernels), the handle still constructs and
+// serves through the inherited TCP path; dds_uring_state/_reason
+// export that verdict as a first-class fact.
+dds_handle* dds_create_uring(int rank, int world, int port) {
+  auto transport = std::make_unique<dds::UringTransport>(rank, world, port);
+  if (transport->server_port() < 0) return nullptr;
+  dds::UringTransport* raw = transport.get();
+  auto* h = new dds_handle();
+  h->store = std::make_unique<Store>(std::move(transport));
+  h->tcp = raw;
+  h->uring = raw;
   raw->Attach(h->store.get());
   return h;
 }
@@ -250,6 +271,103 @@ int dds_cache_evict(dds_handle* h, int64_t window) {
 int dds_tiering_stats(dds_handle* h, int64_t out[16]) {
   if (!h || !out) return dds::kErrInvalidArg;
   h->store->TieringStats(out);
+  return dds::kOk;
+}
+
+// -- io_uring data plane ------------------------------------------------------
+
+// Process-wide capability probe, independent of any store (the diag
+// module reports it before deciding a transport). Layout: [supported,
+// features, op_send, op_recv, op_sendmsg, op_recvmsg, op_read,
+// op_read_fixed, ext_arg, reserved].
+int dds_uring_probe(int64_t out[10]) {
+  if (!out) return dds::kErrInvalidArg;
+  const dds::UringCaps& c = dds::ProbeUring();
+  out[0] = c.supported ? 1 : 0;
+  out[1] = static_cast<int64_t>(c.features);
+  out[2] = c.op_send ? 1 : 0;
+  out[3] = c.op_recv ? 1 : 0;
+  out[4] = c.op_sendmsg ? 1 : 0;
+  out[5] = c.op_recvmsg ? 1 : 0;
+  out[6] = c.op_read ? 1 : 0;
+  out[7] = c.op_read_fixed ? 1 : 0;
+  out[8] = c.ext_arg ? 1 : 0;
+  out[9] = 0;
+  return dds::kOk;
+}
+
+// The probe's human-readable verdict ("ok" or why not). Returns the
+// full reason length; the copy is NUL-terminated and truncated to cap.
+int dds_uring_probe_reason(char* buf, int cap) {
+  const std::string& r = dds::ProbeUring().reason;
+  if (buf && cap > 0) {
+    const int n = static_cast<int>(r.size()) < cap - 1
+                      ? static_cast<int>(r.size())
+                      : cap - 1;
+    std::memcpy(buf, r.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(r.size());
+}
+
+// 1 = uring handle with the ring engaged, 0 = uring handle serving
+// through the TCP fallback (probe refused), -1 = not a uring handle.
+int dds_uring_state(dds_handle* h) {
+  if (!h || !h->uring) return -1;
+  return h->uring->engaged() ? 1 : 0;
+}
+
+// This handle's engagement/fallback reason ("ok" when engaged).
+// Same copy contract as dds_uring_probe_reason; -1 for non-uring.
+int dds_uring_reason(dds_handle* h, char* buf, int cap) {
+  if (!h || !h->uring) return -1;
+  const std::string& r = h->uring->reason();
+  if (buf && cap > 0) {
+    const int n = static_cast<int>(r.size()) < cap - 1
+                      ? static_cast<int>(r.size())
+                      : cap - 1;
+    std::memcpy(buf, r.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int>(r.size());
+}
+
+// Wire-loop counters: [engaged, bursts, enters, sqes, frames,
+// fallbacks, ring_errors]. A healthy engaged run shows enters far
+// below frames (the point); fallbacks counts reads served by the
+// inherited TCP loop after a per-lane ring refusal.
+int dds_uring_stats(dds_handle* h, int64_t out[7]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  if (!h->uring) return dds::kErrInvalidArg;
+  h->uring->UringCounters(out);
+  return dds::kOk;
+}
+
+// Cold-tier O_DIRECT reader counters, any handle: [files, reads,
+// bytes, fallbacks, regbuf, ring_ok].
+int dds_cold_direct_stats(dds_handle* h, int64_t out[6]) {
+  if (!h || !out) return dds::kErrInvalidArg;
+  h->store->ColdDirectStats(out);
+  return dds::kOk;
+}
+
+// Register a READONLY cold var's backing file for O_DIRECT serving
+// (Store::SetVarFile contract: tier-1 vars only; kErrTransport when
+// io_uring/O_DIRECT is unavailable — the var stays on the mmap path).
+int dds_set_var_file(dds_handle* h, const char* name, const char* path) {
+  if (!h || !name || !path) return dds::kErrInvalidArg;
+  return h->store->SetVarFile(name, path);
+}
+
+// Requester-side send gather counters for the TCP pipeline:
+// [req_frames, req_sends]. frames/sends is the writev gather factor
+// the half-window refill buys (1.0 = the old one-sendmsg-per-frame
+// steady state). Works on tcp AND uring handles (the uring wire loop
+// does not count here — its burst gather is visible in
+// dds_uring_stats instead).
+int dds_req_send_stats(dds_handle* h, int64_t out[2]) {
+  if (!h || !out || !h->tcp) return dds::kErrInvalidArg;
+  h->tcp->ReqSendCounters(out);
   return dds::kOk;
 }
 

@@ -58,10 +58,13 @@ namespace metrics {
 constexpr int kNumClasses = 4;
 
 // Route of an op's dominant leg. Ordered by span_latency's attribution
-// precedence (cma beats tcp beats local) so OpTimer::MarkRoute is a
-// plain max-upgrade.
-enum Route : int { kRouteLocal = 0, kRouteTcp = 1, kRouteCma = 2 };
-constexpr int kNumRoutes = 3;
+// precedence (uring beats cma beats tcp beats local) so
+// OpTimer::MarkRoute is a plain max-upgrade. A mixed cma+uring batch
+// attributes to uring: the io_uring wire leg is the one whose regression
+// the histogram plane must surface (the cma leg is unchanged by it).
+enum Route : int { kRouteLocal = 0, kRouteTcp = 1, kRouteCma = 2,
+                   kRouteUring = 3 };
+constexpr int kNumRoutes = 4;
 
 // Log2 buckets. 44 covers [1 ns, ~4.9 h) for latency and
 // [1 B, 16 TiB) for bytes; values past the top clamp into the last

@@ -7,6 +7,7 @@
 #include <thread>
 
 #include "trace.h"
+#include "uring_transport.h"
 #include "worker_pool.h"
 
 namespace dds {
@@ -1606,6 +1607,39 @@ int Store::SetTierPlacement(const std::string& tenant, int cold) {
   return kOk;
 }
 
+int Store::SetVarFile(const std::string& name, const std::string& path) {
+  {
+    std::shared_lock<std::shared_mutex> lock(mu_);
+    auto it = vars_.find(name);
+    if (it == vars_.end()) return kErrNotFound;
+    // O_DIRECT bypasses the page cache: only readonly cold vars may
+    // register (see the store.h contract) — a hot var's mmap writes
+    // would be invisible to direct reads.
+    if (it->second.tier != 1) return kErrInvalidArg;
+  }
+  if (!ProbeUring().supported) return kErrTransport;
+  // Lazy single construction; the exclusive lock only guards the
+  // pointer swap (AddFile's open() runs under the reader's own mutex,
+  // never under mu_).
+  ColdDirectReader* rd;
+  {
+    std::unique_lock<std::shared_mutex> lock(mu_);
+    if (!cold_direct_)
+      cold_direct_ = std::make_unique<ColdDirectReader>();
+    rd = cold_direct_.get();
+  }
+  if (!rd->AddFile(name, path)) return kErrTransport;
+  cold_direct_on_.store(true, std::memory_order_release);
+  return kOk;
+}
+
+void Store::ColdDirectStats(int64_t out[6]) const {
+  for (int i = 0; i < 6; ++i) out[i] = 0;
+  if (!cold_direct_on_.load(std::memory_order_acquire)) return;
+  std::shared_lock<std::shared_mutex> lock(mu_);
+  if (cold_direct_) cold_direct_->Stats(out);
+}
+
 bool Store::ColdPlacementFor(const std::string& name) const {
   if (cold_dir_.empty()) return false;
   const std::string tenant = TenantOfVarName(name);
@@ -2950,6 +2984,12 @@ int Store::ReadLocal(const std::string& name, int64_t offset,
   if (it == vars_.end()) return kErrNotFound;
   const VarInfo& v = it->second;
   if (RangeBad(offset, nbytes, v.shard_bytes())) return kErrOutOfRange;
+  // Cold-tier O_DIRECT path (SetVarFile contract): only after the range
+  // check, so error codes are identical to the mmap path; any reader
+  // refusal (alignment, ring verdict) falls through to the memcpy.
+  if (v.tier == 1 && cold_direct_on_.load(std::memory_order_acquire) &&
+      cold_direct_ && cold_direct_->Read(it->first, offset, nbytes, dst))
+    return kOk;
   std::memcpy(dst, v.base + offset, nbytes);
   return kOk;
 }
@@ -2961,9 +3001,34 @@ int Store::ReadLocalV(const std::string& name, const ReadOp* ops,
   if (it == vars_.end()) return kErrNotFound;
   const VarInfo& v = it->second;
   const int64_t sb = v.shard_bytes();
+  // Validate every range BEFORE any byte moves so the O_DIRECT batch
+  // path and the mmap path surface identical error codes — the mmap
+  // loop below then never hits RangeBad and partial-copy-then-error
+  // behavior matches the pre-hook tree (it copied ops before the first
+  // bad one; an all-good batch is the only case the ring may serve).
+  for (int64_t i = 0; i < n; ++i)
+    if (RangeBad(ops[i].offset, ops[i].nbytes, sb)) {
+      // Preserve the old partial-copy semantics exactly: copy the good
+      // prefix, then report the first bad op.
+      for (int64_t j = 0; j < i; ++j)
+        std::memcpy(ops[j].dst, v.base + ops[j].offset, ops[j].nbytes);
+      return kErrOutOfRange;
+    }
+  if (v.tier == 1 && n > 0 &&
+      cold_direct_on_.load(std::memory_order_acquire) && cold_direct_) {
+    // ReadBatch is all-or-nothing: one ring submission for the whole
+    // run list, or false and the mmap serves everything (no partial
+    // application to reason about).
+    std::vector<ColdDirectReader::CdOp> batch(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; ++i)
+      batch[static_cast<size_t>(i)] = {ops[i].offset, ops[i].nbytes,
+                                       ops[i].dst};
+    if (cold_direct_->ReadBatch(it->first, batch.data(),
+                                static_cast<int>(n)))
+      return kOk;
+  }
   for (int64_t i = 0; i < n; ++i) {
     const ReadOp& op = ops[i];
-    if (RangeBad(op.offset, op.nbytes, sb)) return kErrOutOfRange;
     std::memcpy(op.dst, v.base + op.offset, op.nbytes);
   }
   return kOk;

@@ -192,6 +192,11 @@ struct FailoverStats {
 };
 
 class WorkerPool;
+// O_DIRECT cold-tier reader (uring_transport.h) — forward-declared:
+// store.h cannot include uring_transport.h (it includes tcp_transport.h
+// which includes this header). Store only holds a unique_ptr; the
+// complete type lives where store.cc includes uring_transport.h.
+class ColdDirectReader;
 
 // One-sided read transport. Implementations must be thread-safe: get_batch
 // issues reads to distinct peers concurrently.
@@ -687,6 +692,19 @@ class Store {
   // Placement policy for `tenant`'s mirror fills and kept copies:
   // 1 = cold (file-backed under DDSTORE_TIER_COLD_DIR), 0 = hot.
   int SetTierPlacement(const std::string& tenant, int cold);
+  // Register the backing file of a READONLY cold (tier-1) var so local
+  // reads of it are served via O_DIRECT through the shared submission
+  // ring (ColdDirectReader, uring_transport.h) instead of faulting the
+  // mmap. Only safe for vars that are never updated after registration:
+  // O_DIRECT bypasses the page cache, so a write through the mmap would
+  // be invisible to subsequent direct reads. Returns kErrNotFound for
+  // an unknown var, kErrInvalidArg for a hot (tier-0) var, and
+  // kErrTransport when io_uring/O_DIRECT is unavailable (the var then
+  // simply stays on the mmap path — the caller logs, never fails).
+  int SetVarFile(const std::string& name, const std::string& path);
+  // ColdDirectReader observability: [files, reads, bytes, fallbacks,
+  // regbuf, ring_ok] (zeros when no var was ever registered).
+  void ColdDirectStats(int64_t out[6]) const;
   // Warm the cache with `n` sorted-unique global rows of `name` as
   // window `window` (the eviction key). Advisory: over-budget /
   // duplicate / disabled-cache calls return kOk and do nothing. The
@@ -1312,6 +1330,15 @@ class Store {
   std::map<void*, int64_t> cold_maps_ DDS_GUARDED_BY(cold_mu_);
   std::map<std::string, int> tier_placement_ DDS_GUARDED_BY(cold_mu_);
   std::atomic<int64_t> cold_placed_bytes_{0};
+  // O_DIRECT cold-tier reader (lazily created by the first successful
+  // SetVarFile; null until then). ColdDirectReader serializes itself
+  // (its own data mutex), so ReadLocal/ReadLocalV call it through the
+  // const unique_ptr while holding only the shared vars_ lock.
+  // cold_direct_on_ is the one-relaxed-load guard on the hot read path
+  // — the tree stays byte-identical to the mmap path until a var is
+  // actually registered.
+  std::unique_ptr<ColdDirectReader> cold_direct_;
+  std::atomic<bool> cold_direct_on_{false};
 
   // -- SLO monitor state ---------------------------------------------------
   // Per-tenant latency objectives evaluated over per-window histogram

@@ -21,109 +21,16 @@
 #include <cstring>
 
 #include "trace.h"
+#include "wire.h"
 
 namespace dds {
 namespace {
 
-constexpr uint32_t kMagic = 0xDD57EAD0;
-enum Op : uint32_t { kOpRead = 1, kOpBarrier = 2, kOpReadVec = 3,
-                     kOpCmaInfo = 4,
-                     // Control-plane ops: heartbeat probe (bare ok
-                     // WireResp), shard content-version query (seq
-                     // in resp.nbytes), and snapshot-epoch pin/release
-                     // (snapshot id in req.tag; name carries the
-                     // acquiring tenant label). Deliberately OUTSIDE
-                     // the fault injector's op gate below — control
-                     // frames must not consume data-path draws, or
-                     // seeded chaos schedules would shift with the
-                     // detector (or a snapshot reader) on.
-                     kOpPing = 5, kOpVarSeq = 6,
-                     kOpSnapPin = 7, kOpSnapUnpin = 8,
-                     // Integrity sum fetch (control plane like the
-                     // three above): req.offset = first owner-local
-                     // row, req.nbytes = row count; response payload =
-                     // [int64 seq][count x uint64 sums].
-                     kOpRowSums = 9,
-                     // ddmetrics histogram pull (control plane):
-                     // response payload = the serving store's packed
-                     // metrics::CellRecord snapshot.
-                     kOpMetrics = 10,
-                     // Serving-gateway session control (control
-                     // plane): attach (name = tenant label, tag != 0
-                     // pins a snapshot, offset = quota bytes; minted
-                     // session token returned in resp.nbytes), detach
-                     // and lease renew (tag = session token).
-                     kOpAttach = 11, kOpDetach = 12, kOpLease = 13 };
-
-#pragma pack(push, 1)
-struct WireReq {
-  uint32_t magic;
-  uint32_t op;
-  int32_t src;
-  uint32_t name_len;
-  int64_t offset;
-  int64_t nbytes;
-  int64_t tag;
-};
-struct WireResp {
-  int32_t status;
-  int32_t pad;
-  int64_t nbytes;
-};
-#pragma pack(pop)
-
-// Vectored-read framing: many small ops ride ONE request frame (the op
-// list) answered by ONE concatenated-payload response, so the scattered
-// batch pattern — a DistributedSampler permutation resolving to hundreds
-// of non-adjacent rows per peer — costs ~2 syscalls per FRAME on each
-// side instead of ~2 per ROW (the round-2 bench's 0.163 GB/s was exactly
-// this per-row syscall tax). Ops per frame may exceed Linux IOV_MAX
-// (1024): SendIov/RecvScatter cap each sendmsg/recvmsg at IOV_MAX
-// entries and walk the array in chunks, so the cap here is not the
-// kernel's iovec limit (VERDICT r3 weak #3: the 1024-op cap held
-// scattered 512-byte-row frames to 512 KiB and left frame overhead
-// visible). The byte cap was once the server-scratch bound; the server
-// now streams responses straight out of shard memory (zero intermediate
-// copy), so the cap only bounds how long one frame may hold the store's
-// shared lock mid-send.
-constexpr int64_t kVecMaxOps = 8192;
-constexpr int64_t kVecMaxBytes = 1 << 24;
-constexpr size_t kIovMax = 1024;  // Linux UIO_MAXIOV per sendmsg/recvmsg
-
-// Hybrid zero-copy/packing threshold for vectored frames. Per-iovec
-// kernel cost is REAL for small segments (a 1024-entry sendmsg/recvmsg
-// walk costs far more than memcpying the same bytes — brutally so on
-// sandboxed kernels where the sentry emulates the walk): ops below this
-// size are staged through one contiguous scratch block on each side
-// (server packs before sendmsg, client receives into scratch and
-// scatters with memcpy), so a scatter-class frame of N small rows moves
-// as ~1 iovec, not N. Ops at/above it keep the true zero-copy path —
-// for a bulk stripe chunk the copy would cost more than the iovec entry.
-// NOTE: the wire stream is defined by the op list alone (each op's bytes
-// in op order); how either side chunks its iovecs — including this
-// threshold — is a local optimization and cannot desynchronize framing.
-constexpr int64_t kPackBytes = 16 << 10;
-
-// Byte cap for frames made of PACKABLE (small) ops. Scatter frames are
-// CPU- and cache-bound, not syscall-bound: sub-framing a peer's row
-// list keeps each frame's pack/fixup staging L2-resident on both sides
-// (a monolithic multi-MiB frame thrashes the cache — the 16384-row
-// profile ran at half the 4096-row bandwidth for exactly this reason)
-// and lets the pipeline overlap the server's pack of frame k+1 with the
-// client's receive+fixup of frame k instead of serializing
-// pack -> wire -> fixup across the whole peer batch.
-constexpr int64_t kScatterFrameBytes = 128 << 10;
-
-// Pipelined-ReadV flow control. Frame count alone is not enough: a
-// frame's request can be up to kVecMaxOps * 16 B = 128 KiB of op list,
-// and if the unread request bytes exceed both sides' socket buffers
-// while the server is blocked sending a response the client isn't
-// reading yet, both ends wedge in sendmsg forever. Bound the OUTSTANDING
-// REQUEST BYTES to fit default-sysctl socket buffers (wmem_max/rmem_max
-// are commonly ~208 KiB; SetBufSizes may be silently capped to that),
-// with at least one frame always allowed so progress is guaranteed.
-constexpr int64_t kPipelineWindow = 16;
-constexpr int64_t kPipelineReqBytes = 128 << 10;
+// Framing constants + WireReq/WireResp moved to wire.h (shared with the
+// io_uring backend, which must emit the identical byte stream). Pulled
+// into this anonymous namespace so every pre-existing unqualified
+// reference below still resolves.
+using namespace wire;  // NOLINT
 
 int FullSend(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -1607,6 +1514,8 @@ int TcpTransport::TenantLaneBudget(const std::string& name,
   return it->second.lanes;
 }
 
+int TcpTransport::WireRouteLabel() const { return metrics::kRouteTcp; }
+
 int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
                           const ReadOp* ops, int64_t n) {
   std::lock_guard<std::mutex> lock(c.mu);
@@ -1698,25 +1607,41 @@ int TcpTransport::ReadVOn(Peer& p, Conn& c, const std::string& name,
     // always allowed so the loop can't stall).
     req_iovs.clear();
     int64_t queued_req = inflight_req;
-    while (sent < nframes && sent - recvd < kPipelineWindow &&
-           (sent == recvd ||
-            queued_req + frames[sent].req_bytes <= kPipelineReqBytes)) {
-      const Frame& fr = frames[sent];
-      req_iovs.push_back(iovec{&hdrs[static_cast<size_t>(sent)],
-                               sizeof(WireReq)});
-      req_iovs.push_back(iovec{const_cast<char*>(name.data()), name.size()});
-      if (fr.end - fr.begin > 1)
+    int64_t burst = 0;
+    // Half-window refill: the initial burst always gathers into one
+    // vectored send, but the steady state used to top the window up one
+    // frame per response — one sendmsg per FRAME, the per-frame sentry
+    // tax all over again on the request side. Refill only once the
+    // pipeline has drained to half the window, so steady-state request
+    // traffic moves in ~window/2-frame writev bursts. Framing and frame
+    // ORDER are untouched — the wire byte stream (and the server's
+    // seeded fault-draw schedule) is identical to the one-at-a-time
+    // refill; only the sendmsg boundaries move.
+    if (sent == recvd || sent - recvd <= kPipelineWindow / 2) {
+      while (sent < nframes && sent - recvd < kPipelineWindow &&
+             (sent == recvd ||
+              queued_req + frames[sent].req_bytes <= kPipelineReqBytes)) {
+        const Frame& fr = frames[sent];
+        req_iovs.push_back(iovec{&hdrs[static_cast<size_t>(sent)],
+                                 sizeof(WireReq)});
         req_iovs.push_back(
-            iovec{&all_ops[static_cast<size_t>(2 * fr.begin)],
-                  static_cast<size_t>(fr.end - fr.begin) * 16});
-      queued_req += fr.req_bytes;
-      ++sent;
+            iovec{const_cast<char*>(name.data()), name.size()});
+        if (fr.end - fr.begin > 1)
+          req_iovs.push_back(
+              iovec{&all_ops[static_cast<size_t>(2 * fr.begin)],
+                    static_cast<size_t>(fr.end - fr.begin) * 16});
+        queued_req += fr.req_bytes;
+        ++sent;
+        ++burst;
+      }
     }
     if (!req_iovs.empty()) {
       if (SendIov(c.fd, req_iovs.data(),
                   static_cast<int>(req_iovs.size())) != 0)
         return fail();
       inflight_req = queued_req;
+      req_frames_.fetch_add(burst, std::memory_order_relaxed);
+      req_sends_.fetch_add(1, std::memory_order_relaxed);
     }
     WireResp resp;
     if (FullRecv(c.fd, &resp, sizeof(resp)) != 0) return fail();
@@ -2474,7 +2399,7 @@ int TcpTransport::ReadVMulti(const std::string& name, const PeerReadV* reqs,
   // without a token; cma above outranks this mark).
   for (int64_t ri = 0; ri < nreqs; ++ri)
     if (reqs[ri].n > 0) {
-      metrics::OpTimer::MarkRoute(metrics::kRouteTcp);
+      metrics::OpTimer::MarkRoute(WireRouteLabel());
       break;
     }
   // One lane-count decision per batch, from the matching class's

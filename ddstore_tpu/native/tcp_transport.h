@@ -257,6 +257,15 @@ class TcpTransport : public Transport {
   // Leaf-level retry/reconnect counters ([transient, retries, reconnects,
   // backoff_ms, giveups, fatal, last_peer] — see RetryStats).
   void RetryCounters(int64_t out[7]) const { retry_.Snapshot(out); }
+  // Requester-side gather counters: frames admitted into the pipeline
+  // vs sendmsg bursts that carried them. frames/sends > 1 means the
+  // half-window writev gather is coalescing multi-frame request bursts
+  // into single syscalls (the per-frame sentry tax the uring backend
+  // attacks where io_uring is unavailable).
+  void ReqSendCounters(int64_t out[2]) const {
+    out[0] = req_frames_.load(std::memory_order_relaxed);
+    out[1] = req_sends_.load(std::memory_order_relaxed);
+  }
   // Dissemination barrier: ceil(log2 P) one-way notify rounds per fence
   // (round k: notify rank+2^k, wait for rank-2^k) instead of the round-1
   // flat O(P) notify loop / O(P^2) total messages. FAILURE-AWARE: the
@@ -270,7 +279,10 @@ class TcpTransport : public Transport {
   int world() const override { return world_; }
   WorkerPool* worker_pool() override { return &pool_; }
 
- private:
+ protected:
+  // Protected, not private: UringTransport (uring_transport.h) reuses the
+  // whole lane/peer machinery — pools, autotuner, retry ladder, CMA,
+  // suspect oracle — and overrides ONLY the per-lane wire loop (ReadVOn).
   // One TCP connection to a peer — a "lane". A peer owns a small pool of
   // these (DDSTORE_TCP_LANES; legacy alias DDSTORE_CONNS_PER_PEER): a
   // single stream can't saturate loopback/DCN, and each lane gets its
@@ -335,9 +347,16 @@ class TcpTransport : public Transport {
       DDS_REQUIRES(Conn::mu);
 
   int EnsureConnected(Peer& p, Conn& c) DDS_REQUIRES(Conn::mu);
-  // The pipelined request/response loop over one connection.
-  int ReadVOn(Peer& p, Conn& c, const std::string& name, const ReadOp* ops,
-              int64_t n);
+  // The pipelined request/response loop over one connection. Virtual:
+  // the io_uring backend substitutes a batched-SQE submission for the
+  // sendmsg/recvmsg loop while keeping the byte stream (and therefore
+  // the server-side fault-draw schedule) identical.
+  virtual int ReadVOn(Peer& p, Conn& c, const std::string& name,
+                      const ReadOp* ops, int64_t n);
+  // Route label the wire (non-CMA) leg of ReadVMulti attributes to the
+  // histogram plane. The uring backend overrides this with kRouteUring
+  // so (class, route, peer, tenant) keys distinguish the backends.
+  virtual int WireRouteLabel() const;
   // ReadVOn + transient classification + bounded exponential-backoff
   // retry. Transport-level failures (reset, truncated frame, read
   // timeout) are TRANSIENT; server-reported data errors are FATAL; an
@@ -378,6 +397,9 @@ class TcpTransport : public Transport {
   int uds_listen_fd_ = -1;
   std::thread uds_accept_thread_;
   std::atomic<int64_t> uds_conns_{0};  // UDS dials that succeeded
+  // Requester-side gather counters (see ReqSendCounters).
+  std::atomic<int64_t> req_frames_{0};
+  std::atomic<int64_t> req_sends_{0};
   std::mutex conns_mu_;
   std::vector<std::thread> conn_threads_ DDS_GUARDED_BY(conns_mu_);
   std::vector<int> conn_fds_ DDS_GUARDED_BY(conns_mu_);

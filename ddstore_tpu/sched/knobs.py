@@ -238,7 +238,26 @@ REGISTRY: Dict[str, Knob] = {k.env: k for k in [
     _k("DDSTORE_TRACE_RING", "config",
        desc="per-thread trace ring capacity in events (default 4096); "
             "overflow overwrites oldest and counts a drop"),
+    _k("DDSTORE_TRANSPORT", "config",
+       desc="wire backend inside backend='tcp': 'tcp' (default) or "
+            "'uring' — the io_uring batch loop (one io_uring_enter "
+            "per frame burst; probe-gated with loud TCP fallback, "
+            "byte-identical wire stream either way)"),
     _k("DDSTORE_UDS", "config"),
+    _k("DDSTORE_URING_COLD", "config",
+       desc="O_DIRECT serving of readonly cold (tier-1) shards "
+            "through the submission ring: 1/0 force on/off; 'auto' "
+            "(default) follows the uring wire backend's engagement"),
+    _k("DDSTORE_URING_DEPTH", "config",
+       desc="SQ entries per lane ring (default 256, clamped to "
+            "[64, 4096]); bounds the frames one io_uring_enter can "
+            "carry"),
+    _k("DDSTORE_URING_PHASE_TIMEOUT_S", "config",
+       desc="bench uring-phase subprocess cap, default 300"),
+    _k("DDSTORE_URING_REGBUF", "config",
+       desc="0 disables IORING_REGISTER_BUFFERS/READ_FIXED for the "
+            "cold-tier bounce buffer (default 1; refusal falls back "
+            "to plain IORING_OP_READ silently)"),
     _k("DDSTORE_VERIFY", "config",
        desc="1 = checksum-verify every remote read leg against the "
             "owner's published per-row sums (mismatch -> transient "

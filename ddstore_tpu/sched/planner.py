@@ -311,6 +311,18 @@ class Scheduler:
         except Exception:
             return []
 
+    def _wire_route(self) -> str:
+        """The wire path's route label: "uring" when the store's
+        io_uring wire loop is engaged, else "tcp". Both map to the
+        same native route pin (knob 1)."""
+        try:
+            if self.store is not None and \
+                    self.store.transport_facts().get("wire") == "uring":
+                return "uring"
+        except Exception:
+            pass
+        return "tcp"
+
     def compute(self, cells: Optional[List[dict]] = None) -> Plan:
         """Build (but do not apply) a joint plan from substrate cells.
         ``cells`` defaults to the live native snapshot; the planner
@@ -328,24 +340,28 @@ class Scheduler:
             # Route: argmax over the two measured path cells. Left to
             # the adaptive router until both paths hold clean samples
             # (the router's own collection/calibration does that part).
+            # The wire cell (knob 1) is one PATH with two possible
+            # labels: "tcp", or "uring" when the io_uring wire loop is
+            # engaged — the planner plans across {cma, tcp, uring}
+            # with no fourth tuner (the ring batches the same wire
+            # leg, so the same measurement cell covers it).
+            wire = self._wire_route()
             if f"route_{name}" not in pins:
                 cma = route_cells.get(0)
-                tcp = route_cells.get(1)
-                if cma and tcp and \
+                wc = route_cells.get(1)
+                if cma and wc and \
                         cma["n"] >= WARM_MIN_SAMPLES and \
-                        tcp["n"] >= WARM_MIN_SAMPLES:
-                    cma_bw, tcp_bw = cma["ewma_bps"], tcp["ewma_bps"]
+                        wc["n"] >= WARM_MIN_SAMPLES:
+                    cma_bw, wire_bw = cma["ewma_bps"], wc["ewma_bps"]
                     prev = self._plan.route.get(name)
                     h = _ROUTE_HYSTERESIS[name]
                     if prev is None:
-                        plan.route[name] = "tcp" if tcp_bw > cma_bw \
-                            else "cma"
+                        pick = "wire" if wire_bw > cma_bw else "cma"
                     elif prev == "cma":
-                        plan.route[name] = "tcp" \
-                            if tcp_bw > h * cma_bw else "cma"
-                    else:
-                        plan.route[name] = "cma" \
-                            if cma_bw > h * tcp_bw else "tcp"
+                        pick = "wire" if wire_bw > h * cma_bw else "cma"
+                    else:  # previously on the wire path (tcp or uring)
+                        pick = "cma" if cma_bw > h * wire_bw else "wire"
+                    plan.route[name] = wire if pick == "wire" else "cma"
             # Lanes: model argmax (measured beats extrapolated; the
             # core-budget term caps unmeasured growth).
             if f"lanes_{name}" not in pins:
@@ -355,7 +371,7 @@ class Scheduler:
                 if lane_cells else None
             if t is None and plan.route[name] is not None:
                 rc = route_cells.get(
-                    1 if plan.route[name] == "tcp" else 0)
+                    0 if plan.route[name] == "cma" else 1)
                 t = rc["ewma_bps"] if rc else None
             if t:
                 plan.predicted_gbps[name] = round(t / 1e9, 3)
@@ -432,7 +448,9 @@ class Scheduler:
             return plan
         for name, cls in _CLS.items():
             if f"route_{name}" not in plan.pins:
-                mode = {-1: -1, "cma": 0, "tcp": 1}[
+                # "uring" shares the wire pin (1): the ring is a
+                # different wire LOOP, not a different native route.
+                mode = {-1: -1, "cma": 0, "tcp": 1, "uring": 1}[
                     plan.route[name] if plan.route[name] else -1]
                 self.store.sched_pin_route(cls, mode)
                 plan.engaged = plan.engaged or plan.route[name] is not None

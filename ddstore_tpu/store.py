@@ -270,7 +270,23 @@ class DDStore:
             self._native = NativeStore.create_local(gid, rank, world)
         elif backend == "tcp":
             self._gid = None
-            self._native = NativeStore.create_tcp(rank, world, port)
+            # DDSTORE_TRANSPORT=uring swaps the per-lane wire loop for
+            # the io_uring batch backend (one io_uring_enter per frame
+            # burst). Everything else — peers, lanes, CMA routing,
+            # faults, failover, gateway — is the inherited TcpTransport
+            # machinery, and on an io_uring-less kernel the handle
+            # still constructs and serves plain TCP (uring_state()==0,
+            # uring_reason() says why). Unset/"tcp" is pinned
+            # byte-identical to the pre-uring tree.
+            wire = os.environ.get("DDSTORE_TRANSPORT", "").strip().lower()
+            if wire == "uring":
+                self._native = NativeStore.create_uring(rank, world, port)
+            elif wire in ("", "tcp"):
+                self._native = NativeStore.create_tcp(rank, world, port)
+            else:
+                raise ValueError(
+                    f"DDSTORE_TRANSPORT={wire!r}: expected 'tcp' or "
+                    "'uring' (CMA is a per-read route, not a backend)")
             # Multi-NIC: advertise every DDSTORE_IFACES address (the
             # server listens on INADDR_ANY, so one port serves all NICs)
             # and bind outgoing pool connections to them round-robin.
@@ -636,6 +652,26 @@ class DDStore:
         self.add(name, arr, copy=False, readonly=(mode == "r"))
         self._meta[name].tier = "cold"
         self._native.set_var_tier(self._wname(name), 1)
+        # O_DIRECT serving (DDSTORE_URING_COLD): readonly cold shards
+        # only — a writable mmap's updates would be invisible to
+        # page-cache-bypassing direct reads. Refusal (no io_uring, fs
+        # without O_DIRECT) keeps the var on the mmap path silently.
+        if mode == "r" and nrows and self._cold_direct_wanted():
+            self._native.set_var_file(self._wname(name), path)
+
+    def _cold_direct_wanted(self) -> bool:
+        """DDSTORE_URING_COLD gate for O_DIRECT cold-tier serving:
+        1/0 force it on/off; ``auto`` (default) follows the wire
+        backend — on exactly when this store's io_uring transport
+        engaged (same kernel verdict; the cold ring reuses the same
+        probe). Registration itself may still refuse (filesystem
+        without O_DIRECT) — that is per-var and silent."""
+        v = os.environ.get("DDSTORE_URING_COLD", "auto").strip().lower()
+        if v in ("1", "on", "true"):
+            return True
+        if v in ("0", "off", "false"):
+            return False
+        return self.backend == "tcp" and self._native.uring_state() == 1
 
     def add_mmap(self, name: str, path: str, dtype,
                  sample_shape: Tuple[int, ...], mode: str = "r") -> None:
@@ -673,6 +709,10 @@ class DDStore:
         m.readonly = True
         m.tier = "cold"
         self._native.set_var_tier(self._wname(name), 1)
+        # Spilled shards are readonly by construction — eligible for
+        # O_DIRECT serving under the same gate as add_file.
+        if nrows and self._cold_direct_wanted():
+            self._native.set_var_file(self._wname(name), path)
         # Collective completion: once any rank returns, every rank's swap
         # is done (mirrors add()'s barrier guarantee).
         self.barrier()
@@ -1279,6 +1319,25 @@ class DDStore:
         into ``summary()["bytes_moved"]``'s lane view. ``[]`` for the
         local backend."""
         return self._native.lane_bytes(target)
+
+    def transport_facts(self) -> dict:
+        """First-class wire-backend verdict: ``backend`` (the store
+        backend), ``wire`` ("uring" when the io_uring loop is engaged,
+        else "tcp"/"local"), ``uring_engaged`` and ``uring_reason``
+        (the capability probe's words when a requested uring backend
+        fell back — never a crash). Bench/diag record this so a
+        TCP-fallback run is diagnosable from its artifacts alone."""
+        facts = {"backend": self.backend, "wire": self.backend,
+                 "uring_engaged": False, "uring_reason": ""}
+        if self.backend != "tcp":
+            return facts
+        state = self._native.uring_state()
+        if state < 0:  # plain TCP handle
+            return facts
+        facts["uring_engaged"] = state == 1
+        facts["uring_reason"] = self._native.uring_reason()
+        facts["wire"] = "uring" if state == 1 else "tcp"
+        return facts
 
     # -- cost-model scheduler hooks ---------------------------------------
 

@@ -24,9 +24,9 @@ NATIVE = os.path.join(HERE, "ddstore_tpu", "native")
 # Keep in sync with ddstore_tpu/_build.py _SOURCES (not imported: pulling
 # in the package here would trigger its lazy native build mid-setup).
 SOURCES = ["store.cc", "local_transport.cc", "tcp_transport.cc",
-           "worker_pool.cc", "cma.cc", "fault.cc", "gateway.cc",
-           "health.cc", "integrity.cc", "metrics_hist.cc", "tier.cc",
-           "trace.cc", "capi.cc"]
+           "uring_transport.cc", "worker_pool.cc", "cma.cc", "fault.cc",
+           "gateway.cc", "health.cc", "integrity.cc", "metrics_hist.cc",
+           "tier.cc", "trace.cc", "capi.cc"]
 
 
 def compile_native(out_dir: str) -> str:
